@@ -1,0 +1,86 @@
+"""North-star benchmark: ResNet-18 Tiny-ImageNet training throughput.
+
+Prints ONE JSON line:
+  {"metric": "resnet18_tiny_imagenet_train_images_per_sec", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is measured
+against REFERENCE_GPU_IMG_PER_SEC — a documented estimate of the reference's
+CUDA path on a single consumer GPU for this exact config (ResNet-18, 64×64,
+fp32, batch 256): ~1500 img/s. Replace with a measured number when the
+reference can be run on GPU hardware.
+
+Runs the full jitted train step (forward+backward+Adam update) on synthetic
+data resident in HBM, so the number isolates compute+HBM (the reference's
+benchmarks do the same — synthetic tensors, no input pipeline).
+
+Env knobs: BENCH_BATCH (default 256), BENCH_STEPS (default 30),
+DCNN_PRECISION (default fast = bf16 MXU passes; set "parity" for fp32),
+BENCH_FORMAT (NHWC default — TPU-preferred tiling; set NCHW for the
+reference's layout).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("DCNN_PRECISION", "fast")
+
+REFERENCE_GPU_IMG_PER_SEC = 1500.0
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dcnn_tpu.models import create_resnet18_tiny_imagenet
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train import make_train_step
+    from dcnn_tpu.train.trainer import create_train_state
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    data_format = os.environ.get("BENCH_FORMAT", "NHWC")
+
+    model = create_resnet18_tiny_imagenet(data_format)
+    opt = Adam(1e-3)
+    key = jax.random.PRNGKey(0)
+    ts = create_train_state(model, opt, key)
+    step = make_train_step(model, softmax_cross_entropy, opt)
+
+    shape = (batch, 3, 64, 64) if data_format == "NCHW" else (batch, 64, 64, 3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    y = jnp.asarray(np.eye(200, dtype=np.float32)[rng.integers(0, 200, size=batch)])
+
+    # warmup / compile
+    ts, loss, _ = step(ts, x, y, key, 1e-3)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet18_tiny_imagenet_train_images_per_sec",
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / REFERENCE_GPU_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""dcnn_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the reference
+C++/CUDA framework tungphambasement/DCNN (``tnn``): an NCHW CNN layer library
+with Sequential container + builder + JSON config, optimizers/losses/schedulers,
+data loaders + augmentations, checkpointing, per-layer profiling, and — as the
+distributed core — microbatched pipeline parallelism (sync / semi-async /
+compiled 1F1B over a TPU mesh) plus data-parallel sharding via ``jax.sharding``.
+
+Design stance (see SURVEY.md §7): idiomatic JAX — jit-compiled pure functions,
+pytree parameters, functional optimizers, ``shard_map`` over a device Mesh with
+XLA collectives over ICI — not a translation of the reference's mutable
+object-per-layer CUDA design.
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("DCNN_PLATFORM"):
+    # Select the JAX backend ("tpu", "cpu", …) before any computation. Set via
+    # config, not JAX_PLATFORMS: PJRT plugins registered from sitecustomize may
+    # force their own jax_platforms value, and the config update wins.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["DCNN_PLATFORM"])
+
+from . import core, nn, ops, optim
+
+__all__ = ["core", "nn", "ops", "optim", "__version__"]

@@ -1,0 +1,24 @@
+"""Core runtime: device discovery, mesh helpers, configuration.
+
+TPU-native analog of the reference's device runtime layer
+(``include/device/device_manager.hpp``, ``include/device/context.hpp``): where
+the reference discovers CPU + CUDA devices and hands out contexts/streams, we
+discover JAX backends (TPU/CPU) and hand out devices and ``jax.sharding.Mesh``
+objects. There is no Task/Flow analog — XLA's async dispatch already provides
+the "every op returns an async handle" model the reference built by hand
+(SURVEY.md §1, "Async task model").
+"""
+
+from .device import DeviceManager, default_device, device_count, local_devices
+from .mesh import make_mesh, mesh_axes
+from .config import TrainingConfig
+
+__all__ = [
+    "DeviceManager",
+    "default_device",
+    "device_count",
+    "local_devices",
+    "make_mesh",
+    "mesh_axes",
+    "TrainingConfig",
+]

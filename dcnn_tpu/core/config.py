@@ -1,0 +1,63 @@
+"""Training configuration.
+
+Reference equivalent: ``TrainingConfig`` + ``load_from_env``
+(``/root/reference/include/nn/train.hpp:46-101``), which maps EPOCHS /
+BATCH_SIZE / LR_DECAY_* / NUM_MICROBATCHES / DEVICE_TYPE / PROFILER_TYPE
+environment variables into the trainer. Same variable names are honored here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from enum import Enum
+from typing import Optional
+
+from ..utils.env import get_env
+
+
+class ProfilerType(Enum):
+    """Per-layer profiling mode (reference ``train.hpp:37``)."""
+
+    NONE = "none"
+    NORMAL = "normal"          # cleared every batch
+    CUMULATIVE = "cumulative"  # accumulated across the epoch
+
+
+@dataclass
+class TrainingConfig:
+    epochs: int = 10
+    batch_size: int = 64
+    learning_rate: float = 1e-3
+    lr_decay_factor: float = 1.0      # multiplicative per-epoch decay (train.hpp:282-288)
+    lr_decay_interval: int = 1
+    num_microbatches: int = 1
+    device_type: str = "tpu"          # "tpu" | "cpu"
+    profiler: ProfilerType = ProfilerType.NONE
+    seed: int = 42
+    snapshot_dir: Optional[str] = "model_snapshots"
+    progress_interval: int = 100      # batches between progress prints (train.hpp:149-162)
+    dtype: str = "float32"            # "float32" parity mode | "bfloat16" fast mode
+
+    @classmethod
+    def load_from_env(cls) -> "TrainingConfig":
+        """Environment-variable mapping mirroring ``train.hpp:80-100``."""
+        base = cls()
+        return cls(
+            epochs=get_env("EPOCHS", base.epochs),
+            batch_size=get_env("BATCH_SIZE", base.batch_size),
+            learning_rate=get_env("LEARNING_RATE", base.learning_rate),
+            lr_decay_factor=get_env("LR_DECAY_FACTOR", base.lr_decay_factor),
+            lr_decay_interval=get_env("LR_DECAY_INTERVAL", base.lr_decay_interval),
+            num_microbatches=get_env("NUM_MICROBATCHES", base.num_microbatches),
+            device_type=get_env("DEVICE_TYPE", base.device_type),
+            profiler=ProfilerType(get_env("PROFILER_TYPE", base.profiler.value).lower()),
+            seed=get_env("SEED", base.seed),
+            snapshot_dir=get_env("SNAPSHOT_DIR", base.snapshot_dir or "model_snapshots"),
+            progress_interval=get_env("PROGRESS_INTERVAL", base.progress_interval),
+            dtype=get_env("DTYPE", base.dtype),
+        )
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["profiler"] = self.profiler.value
+        return d

@@ -1,0 +1,56 @@
+"""Device-mesh construction helpers.
+
+The reference's only multi-device topology is a linear chain of pipeline
+stages over TCP (``include/pipeline/coordinator.hpp:517-555``). The TPU-native
+equivalent is a ``jax.sharding.Mesh`` whose axes name the parallelism
+dimensions; collectives then ride ICI. Canonical axes used across this
+framework:
+
+- ``"data"``  — batch (data parallel) axis; gradient psum rides ICI.
+- ``"stage"`` — pipeline-stage axis (the analog of the reference's worker
+  chain); activations move with ``ppermute``.
+- ``"model"`` — reserved for tensor parallelism of wide layers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+DATA_AXIS = "data"
+STAGE_AXIS = "stage"
+MODEL_AXIS = "model"
+
+
+def mesh_axes() -> Tuple[str, str, str]:
+    return (DATA_AXIS, STAGE_AXIS, MODEL_AXIS)
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all local devices).
+
+    ``make_mesh()`` → 1-D data mesh over every device.
+    ``make_mesh((4, 2), ("data", "stage"))`` → 4-way DP × 2-stage pipeline.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(f"mesh shape {tuple(shape)} does not cover {len(devs)} devices")
+    if len(shape) != len(axis_names):
+        raise ValueError("shape and axis_names rank mismatch")
+    arr = np.asarray(devs, dtype=object).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def single_device_mesh(axis_name: str = DATA_AXIS) -> Mesh:
+    """1-device mesh — lets sharded code paths run unmodified on one chip."""
+    return make_mesh((1,), (axis_name,), devices=jax.devices()[:1])

@@ -1,0 +1,48 @@
+"""Matmul/conv precision policy.
+
+The reference computes in pure fp32 (SURVEY.md §7 hard part 6). On TPU the
+MXU natively multiplies in bf16; XLA's *default* precision uses that fast
+path, while ``HIGHEST`` runs fp32-equivalent multi-pass matmuls. Policy:
+
+- ``"parity"`` (default): ``Precision.HIGHEST`` — numerics match the
+  reference/torch to ~1e-5, used by tests and parity runs.
+- ``"fast"``: ``Precision.DEFAULT`` — bf16 MXU passes, the TPU-idiomatic
+  training mode used by benchmarks (top-1 parity for CNNs, ~2-8× matmul
+  throughput).
+
+Set globally via ``set_precision`` or the ``DCNN_PRECISION`` env var; ops read
+it at trace time so a jit cache key change (re-trace) applies it.
+"""
+
+from __future__ import annotations
+
+import os
+
+from jax import lax
+
+_MODES = {
+    "parity": lax.Precision.HIGHEST,
+    "highest": lax.Precision.HIGHEST,
+    "fast": lax.Precision.DEFAULT,
+    "default": lax.Precision.DEFAULT,
+}
+
+_current = os.environ.get("DCNN_PRECISION", "parity").lower()
+if _current not in _MODES:
+    _current = "parity"
+
+
+def set_precision(mode: str) -> None:
+    global _current
+    mode = mode.lower()
+    if mode not in _MODES:
+        raise ValueError(f"unknown precision mode {mode!r}; known: {sorted(_MODES)}")
+    _current = mode
+
+
+def get_precision() -> lax.Precision:
+    return _MODES[_current]
+
+
+def get_precision_mode() -> str:
+    return _current

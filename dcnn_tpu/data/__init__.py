@@ -1,0 +1,23 @@
+"""Data loading + augmentation (reference ``include/data_loading/``,
+``include/data_augmentation/``)."""
+
+from .loader import BaseDataLoader, ArrayDataLoader, one_hot
+from .mnist import MNISTDataLoader
+from .cifar import CIFAR10DataLoader, CIFAR100DataLoader
+from .tiny_imagenet import TinyImageNetDataLoader
+from .wifi import UJIWiFiDataLoader
+from .synthetic import SyntheticClassificationLoader
+from .augment import (
+    AugmentationBuilder, AugmentationStrategy,
+    brightness, contrast, cutout, gaussian_noise, horizontal_flip,
+    normalization, random_crop, rotation, vertical_flip,
+)
+
+__all__ = [
+    "BaseDataLoader", "ArrayDataLoader", "one_hot",
+    "MNISTDataLoader", "CIFAR10DataLoader", "CIFAR100DataLoader",
+    "TinyImageNetDataLoader", "UJIWiFiDataLoader", "SyntheticClassificationLoader",
+    "AugmentationStrategy", "AugmentationBuilder",
+    "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
+    "vertical_flip", "normalization", "random_crop", "rotation",
+]

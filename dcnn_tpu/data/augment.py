@@ -1,0 +1,218 @@
+"""Data augmentations.
+
+Reference equivalent: the 9 augmentation ops + ``AugmentationStrategy``
+pipeline + ``AugmentationBuilder`` fluent API
+(``include/data_augmentation/augmentation.hpp:17-114``,
+``src/data_augmentation/augmentation.cpp``): Brightness, Contrast, Cutout,
+GaussianNoise, HorizontalFlip, VerticalFlip, Normalization, RandomCrop,
+Rotation.
+
+Implemented as vectorized numpy batch transforms (applied host-side at batch
+assembly, like the reference's per-batch hook). Each op takes
+``(batch NCHW/NHWC float32, np.random.Generator)`` and a probability of
+applying per-sample. Rotation uses scipy.ndimage.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+BatchFn = Callable[[np.ndarray, np.random.Generator], np.ndarray]
+
+
+def _hw_axes(data_format: str) -> Tuple[int, int]:
+    return (2, 3) if data_format == "NCHW" else (1, 2)
+
+
+def _mask(rng: np.random.Generator, n: int, p: float) -> np.ndarray:
+    return rng.random(n) < p
+
+
+def brightness(delta: float = 0.2, p: float = 0.5) -> BatchFn:
+    """Additive brightness jitter in [-delta, delta]."""
+    def fn(x, rng):
+        m = _mask(rng, len(x), p)
+        shifts = rng.uniform(-delta, delta, size=(len(x),)).astype(np.float32)
+        shifts = np.where(m, shifts, 0.0)
+        return x + shifts.reshape(-1, *([1] * (x.ndim - 1)))
+    return fn
+
+
+def contrast(lower: float = 0.8, upper: float = 1.2, p: float = 0.5,
+             data_format: str = "NCHW") -> BatchFn:
+    """Scale around the per-image mean by a factor in [lower, upper]."""
+    def fn(x, rng):
+        m = _mask(rng, len(x), p)
+        factors = rng.uniform(lower, upper, size=(len(x),)).astype(np.float32)
+        factors = np.where(m, factors, 1.0).reshape(-1, *([1] * (x.ndim - 1)))
+        mean = x.mean(axis=tuple(range(1, x.ndim)), keepdims=True)
+        return (x - mean) * factors + mean
+    return fn
+
+
+def cutout(size: int = 8, p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
+    """Zero a random size×size square per image (reference Cutout)."""
+    ha, wa = _hw_axes(data_format)
+
+    def fn(x, rng):
+        h, w = x.shape[ha], x.shape[wa]
+        for i in range(len(x)):
+            if rng.random() >= p:
+                continue
+            cy, cx = rng.integers(0, h), rng.integers(0, w)
+            y0, y1 = max(0, cy - size // 2), min(h, cy + size // 2)
+            x0, x1 = max(0, cx - size // 2), min(w, cx + size // 2)
+            if data_format == "NCHW":
+                x[i, :, y0:y1, x0:x1] = 0.0
+            else:
+                x[i, y0:y1, x0:x1, :] = 0.0
+        return x
+    return fn
+
+
+def gaussian_noise(std: float = 0.05, p: float = 0.5) -> BatchFn:
+    def fn(x, rng):
+        m = _mask(rng, len(x), p).reshape(-1, *([1] * (x.ndim - 1)))
+        noise = rng.normal(0.0, std, size=x.shape).astype(np.float32)
+        return x + np.where(m, noise, 0.0)
+    return fn
+
+
+def horizontal_flip(p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
+    _, wa = _hw_axes(data_format)
+
+    def fn(x, rng):
+        m = _mask(rng, len(x), p)
+        x[m] = np.flip(x[m], axis=wa)
+        return x
+    return fn
+
+
+def vertical_flip(p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
+    ha, _ = _hw_axes(data_format)
+
+    def fn(x, rng):
+        m = _mask(rng, len(x), p)
+        x[m] = np.flip(x[m], axis=ha)
+        return x
+    return fn
+
+
+def normalization(mean: Sequence[float], std: Sequence[float],
+                  data_format: str = "NCHW") -> BatchFn:
+    """Per-channel (x-mean)/std (reference Normalization — always applied)."""
+    mean_a = np.asarray(mean, np.float32)
+    std_a = np.asarray(std, np.float32)
+
+    def fn(x, rng):
+        if data_format == "NCHW":
+            return (x - mean_a.reshape(1, -1, 1, 1)) / std_a.reshape(1, -1, 1, 1)
+        return (x - mean_a) / std_a
+    return fn
+
+
+def random_crop(padding: int = 4, p: float = 1.0, data_format: str = "NCHW") -> BatchFn:
+    """Pad by ``padding`` (reflect zeros) then crop back at a random offset."""
+    ha, wa = _hw_axes(data_format)
+
+    def fn(x, rng):
+        h, w = x.shape[ha], x.shape[wa]
+        pad_spec = [(0, 0)] * x.ndim
+        pad_spec[ha] = (padding, padding)
+        pad_spec[wa] = (padding, padding)
+        padded = np.pad(x, pad_spec)
+        out = x
+        for i in range(len(x)):
+            if rng.random() >= p:
+                continue
+            oy = rng.integers(0, 2 * padding + 1)
+            ox = rng.integers(0, 2 * padding + 1)
+            if data_format == "NCHW":
+                out[i] = padded[i, :, oy:oy + h, ox:ox + w]
+            else:
+                out[i] = padded[i, oy:oy + h, ox:ox + w, :]
+        return out
+    return fn
+
+
+def rotation(max_degrees: float = 15.0, p: float = 0.5,
+             data_format: str = "NCHW") -> BatchFn:
+    from scipy import ndimage
+    ha, wa = _hw_axes(data_format)
+
+    def fn(x, rng):
+        for i in range(len(x)):
+            if rng.random() >= p:
+                continue
+            deg = float(rng.uniform(-max_degrees, max_degrees))
+            x[i] = ndimage.rotate(x[i], deg, axes=(ha - 1, wa - 1),
+                                  reshape=False, order=1, mode="nearest")
+        return x
+    return fn
+
+
+class AugmentationStrategy:
+    """Ordered augmentation pipeline (reference ``AugmentationStrategy``,
+    augmentation.hpp:51)."""
+
+    def __init__(self, ops: Optional[List[BatchFn]] = None):
+        self.ops: List[BatchFn] = list(ops or [])
+
+    def add(self, op: BatchFn) -> "AugmentationStrategy":
+        self.ops.append(op)
+        return self
+
+    def __call__(self, batch: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        for op in self.ops:
+            batch = op(batch, rng)
+        return batch
+
+
+class AugmentationBuilder:
+    """Fluent construction (reference ``AugmentationBuilder``,
+    augmentation.hpp:114)."""
+
+    def __init__(self, data_format: str = "NCHW"):
+        self._strategy = AugmentationStrategy()
+        self.data_format = data_format
+
+    def brightness(self, delta: float = 0.2, p: float = 0.5):
+        self._strategy.add(brightness(delta, p))
+        return self
+
+    def contrast(self, lower: float = 0.8, upper: float = 1.2, p: float = 0.5):
+        self._strategy.add(contrast(lower, upper, p, self.data_format))
+        return self
+
+    def cutout(self, size: int = 8, p: float = 0.5):
+        self._strategy.add(cutout(size, p, self.data_format))
+        return self
+
+    def gaussian_noise(self, std: float = 0.05, p: float = 0.5):
+        self._strategy.add(gaussian_noise(std, p))
+        return self
+
+    def horizontal_flip(self, p: float = 0.5):
+        self._strategy.add(horizontal_flip(p, self.data_format))
+        return self
+
+    def vertical_flip(self, p: float = 0.5):
+        self._strategy.add(vertical_flip(p, self.data_format))
+        return self
+
+    def normalization(self, mean: Sequence[float], std: Sequence[float]):
+        self._strategy.add(normalization(mean, std, self.data_format))
+        return self
+
+    def random_crop(self, padding: int = 4, p: float = 1.0):
+        self._strategy.add(random_crop(padding, p, self.data_format))
+        return self
+
+    def rotation(self, max_degrees: float = 15.0, p: float = 0.5):
+        self._strategy.add(rotation(max_degrees, p, self.data_format))
+        return self
+
+    def build(self) -> AugmentationStrategy:
+        return self._strategy

@@ -1,0 +1,87 @@
+"""CIFAR-10 / CIFAR-100 binary loaders.
+
+Reference equivalent: ``CIFAR10DataLoader`` / ``CIFAR100DataLoader``
+(``include/data_loading/cifar10_data_loader.hpp:37-63``,
+``cifar100_data_loader.hpp:37-105``). Format: records of
+``[label_byte][3072 pixel bytes]`` (CIFAR-10) or
+``[coarse_byte][fine_byte][3072 pixel bytes]`` (CIFAR-100), pixels stored
+plane-major R,G,B as 3×32×32, normalized by 255.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from .loader import BaseDataLoader, one_hot
+
+_IMG_BYTES = 3 * 32 * 32
+
+CIFAR10_CLASS_NAMES = ["airplane", "automobile", "bird", "cat", "deer",
+                       "dog", "frog", "horse", "ship", "truck"]
+
+
+class CIFAR10DataLoader(BaseDataLoader):
+    NUM_CLASSES = 10
+
+    def __init__(self, files: Sequence[str] | str, data_format: str = "NCHW", **kw):
+        super().__init__(**kw)
+        self.files: List[str] = [files] if isinstance(files, str) else list(files)
+        self.data_format = data_format
+
+    def load_data(self) -> None:
+        imgs, labels = [], []
+        rec = 1 + _IMG_BYTES
+        for path in self.files:
+            if not os.path.isfile(path):
+                raise FileNotFoundError(path)
+            raw = np.fromfile(path, dtype=np.uint8)
+            if len(raw) % rec != 0:
+                raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
+            raw = raw.reshape(-1, rec)
+            labels.append(raw[:, 0].astype(np.int64))
+            imgs.append(raw[:, 1:].reshape(-1, 3, 32, 32))
+        x = np.concatenate(imgs).astype(np.float32) / 255.0
+        if self.data_format == "NHWC":
+            x = np.transpose(x, (0, 2, 3, 1))
+        self._x = np.ascontiguousarray(x)
+        self._y = one_hot(np.concatenate(labels), self.NUM_CLASSES)
+
+
+class CIFAR100DataLoader(BaseDataLoader):
+    """CIFAR-100 with fine (default) or coarse labels
+    (reference cifar100_data_loader.hpp:37,105)."""
+
+    def __init__(self, files: Sequence[str] | str, data_format: str = "NCHW",
+                 label_mode: str = "fine", **kw):
+        super().__init__(**kw)
+        self.files: List[str] = [files] if isinstance(files, str) else list(files)
+        self.data_format = data_format
+        if label_mode not in ("fine", "coarse"):
+            raise ValueError("label_mode must be 'fine' or 'coarse'")
+        self.label_mode = label_mode
+
+    @property
+    def NUM_CLASSES(self) -> int:  # noqa: N802 - constant-style
+        return 100 if self.label_mode == "fine" else 20
+
+    def load_data(self) -> None:
+        imgs, labels = [], []
+        rec = 2 + _IMG_BYTES
+        for path in self.files:
+            if not os.path.isfile(path):
+                raise FileNotFoundError(path)
+            raw = np.fromfile(path, dtype=np.uint8)
+            if len(raw) % rec != 0:
+                raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
+            raw = raw.reshape(-1, rec)
+            col = 1 if self.label_mode == "fine" else 0
+            labels.append(raw[:, col].astype(np.int64))
+            imgs.append(raw[:, 2:].reshape(-1, 3, 32, 32))
+        x = np.concatenate(imgs).astype(np.float32) / 255.0
+        if self.data_format == "NHWC":
+            x = np.transpose(x, (0, 2, 3, 1))
+        self._x = np.ascontiguousarray(x)
+        self._y = one_hot(np.concatenate(labels), self.NUM_CLASSES)

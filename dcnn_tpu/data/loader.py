@@ -1,0 +1,96 @@
+"""Data-loader base.
+
+Reference equivalent: ``BaseDataLoader`` / ``ImageDataLoader``
+(``include/data_loading/data_loader.hpp:25-187``): batch iteration, shuffle,
+``prepare_batches``, augmentation hook, one-hot helper.
+
+Loaders here produce numpy NCHW (or NHWC) batches on the host; device
+placement happens in the jitted step (the H2D boundary the reference hits at
+``batch.to_device``, train.hpp call stack SURVEY.md §3.1). Augmentations run
+as vectorized numpy per-batch transforms at iteration time, so each epoch
+resamples them — same behavior as the reference's per-batch
+``AugmentationStrategy`` hook.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+def one_hot(labels: np.ndarray, num_classes: int, dtype=np.float32) -> np.ndarray:
+    """One-hot targets (reference data_loader.hpp one-hot helper)."""
+    out = np.zeros((len(labels), num_classes), dtype)
+    out[np.arange(len(labels)), np.asarray(labels, np.int64)] = 1
+    return out
+
+
+class BaseDataLoader:
+    """Iterable over (x, y) batches with shuffle + augmentation hook."""
+
+    def __init__(self, batch_size: int = 64, shuffle: bool = True,
+                 drop_last: bool = True, seed: int = 0,
+                 augmentation: Optional[Callable[[np.ndarray, np.random.Generator],
+                                                 np.ndarray]] = None):
+        self.batch_size = int(batch_size)
+        self._shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        self.seed = int(seed)
+        self.augmentation = augmentation
+        self._epoch = 0
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+
+    # subclasses populate _x/_y in load_data()
+    def load_data(self) -> None:
+        raise NotImplementedError
+
+    def _ensure_loaded(self):
+        if self._x is None:
+            self.load_data()
+        if self._x is None or self._y is None:
+            raise RuntimeError("load_data() did not populate data")
+
+    def __len__(self) -> int:
+        self._ensure_loaded()
+        n = len(self._x)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    @property
+    def num_samples(self) -> int:
+        self._ensure_loaded()
+        return len(self._x)
+
+    def shuffle(self, epoch: int) -> None:
+        """Reshuffle ordering for a new epoch (reference
+        ``prepare_batches``-with-shuffle semantics)."""
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        self._ensure_loaded()
+        n = len(self._x)
+        rng = np.random.default_rng(self.seed + self._epoch)
+        idx = rng.permutation(n) if self._shuffle else np.arange(n)
+        stop = n - n % self.batch_size if self.drop_last else n
+        for start in range(0, stop, self.batch_size):
+            take = idx[start:start + self.batch_size]
+            xb = self._x[take]
+            yb = self._y[take]
+            if self.augmentation is not None:
+                xb = self.augmentation(xb.copy(), rng)
+            yield xb, yb
+
+
+class ArrayDataLoader(BaseDataLoader):
+    """Loader over in-memory arrays (test/synthetic backend)."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, **kw):
+        super().__init__(**kw)
+        self._x = np.asarray(x)
+        self._y = np.asarray(y)
+
+    def load_data(self) -> None:
+        pass

@@ -1,0 +1,38 @@
+"""MNIST CSV loader.
+
+Reference equivalent: ``MNISTDataLoader``
+(``include/data_loading/mnist_data_loader.hpp:36-331``): CSV rows of
+``label,pix0..pix783`` (header skipped), pixels normalized by 255
+(NORMALIZATION_FACTOR, :27), shaped 1×28×28, labels one-hot 10.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .loader import BaseDataLoader, one_hot
+
+
+class MNISTDataLoader(BaseDataLoader):
+    NUM_CLASSES = 10
+
+    def __init__(self, csv_path: str, data_format: str = "NCHW", **kw):
+        super().__init__(**kw)
+        self.csv_path = csv_path
+        self.data_format = data_format
+
+    def load_data(self) -> None:
+        if not os.path.isfile(self.csv_path):
+            raise FileNotFoundError(self.csv_path)
+        raw = np.loadtxt(self.csv_path, delimiter=",", skiprows=1, dtype=np.float32)
+        if raw.ndim == 1:
+            raw = raw[None]
+        labels = raw[:, 0].astype(np.int64)
+        pixels = raw[:, 1:] / 255.0
+        imgs = pixels.reshape(-1, 1, 28, 28)
+        if self.data_format == "NHWC":
+            imgs = np.transpose(imgs, (0, 2, 3, 1))
+        self._x = np.ascontiguousarray(imgs, np.float32)
+        self._y = one_hot(labels, self.NUM_CLASSES)

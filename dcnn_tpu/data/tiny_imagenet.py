@@ -1,0 +1,126 @@
+"""Tiny-ImageNet-200 loader.
+
+Reference equivalent: ``TinyImageNetDataLoader``
+(``include/data_loading/tiny_imagenet_data_loader.hpp:26-132``): reads
+``wnids.txt`` (class ids), ``words.txt`` (names), train split from
+``train/<wnid>/images/*.JPEG``, val split from ``val/images`` +
+``val/val_annotations.txt``; JPEG decode via stb_image (PIL here), RGB,
+normalized by 255, 3×64×64.
+
+Decoding thousands of JPEGs on the host is the input-pipeline bottleneck for
+TPU feeding (SURVEY.md §7 hard part 5); this loader decodes once up front
+into a memory-resident float array (240 MB for the train split) and can
+persist an ``.npz`` cache next to the dataset so later epochs/restarts skip
+decode entirely.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .loader import BaseDataLoader, one_hot
+
+
+def _decode_image(path: str) -> np.ndarray:
+    from PIL import Image
+    with Image.open(path) as im:
+        arr = np.asarray(im.convert("RGB"), np.uint8)
+    return arr  # HWC
+
+
+class TinyImageNetDataLoader(BaseDataLoader):
+    NUM_CLASSES = 200
+
+    def __init__(self, root: str, split: str = "train", data_format: str = "NCHW",
+                 cache: bool = True, max_per_class: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.root = root
+        if split not in ("train", "val"):
+            raise ValueError("split must be 'train' or 'val'")
+        self.split = split
+        self.data_format = data_format
+        self.cache = cache
+        self.max_per_class = max_per_class
+        self.wnid_to_idx: Dict[str, int] = {}
+        self.class_names: Dict[str, str] = {}
+
+    def _load_wnids(self) -> None:
+        wnids_path = os.path.join(self.root, "wnids.txt")
+        with open(wnids_path, "r", encoding="utf-8") as f:
+            wnids = [line.strip() for line in f if line.strip()]
+        self.wnid_to_idx = {w: i for i, w in enumerate(sorted(wnids))}
+        words_path = os.path.join(self.root, "words.txt")
+        if os.path.isfile(words_path):
+            with open(words_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    parts = line.rstrip("\n").split("\t")
+                    if len(parts) >= 2 and parts[0] in self.wnid_to_idx:
+                        self.class_names[parts[0]] = parts[1]
+
+    def _cache_path(self) -> str:
+        suffix = f"_{self.max_per_class}" if self.max_per_class else ""
+        return os.path.join(self.root, f"_dcnn_cache_{self.split}{suffix}.npz")
+
+    def load_data(self) -> None:
+        cache_path = self._cache_path()
+        if self.cache and os.path.isfile(cache_path):
+            blob = np.load(cache_path)
+            x, labels = blob["x"], blob["labels"]
+        else:
+            self._load_wnids()
+            if self.split == "train":
+                x, labels = self._load_train()
+            else:
+                x, labels = self._load_val()
+            if self.cache:
+                try:
+                    np.savez(cache_path, x=x, labels=labels)
+                except OSError:
+                    pass
+        x = x.astype(np.float32) / 255.0
+        x = np.transpose(x, (0, 3, 1, 2))  # HWC→CHW
+        if self.data_format == "NHWC":
+            x = np.transpose(x, (0, 2, 3, 1))
+        self._x = np.ascontiguousarray(x)
+        self._y = one_hot(labels, self.NUM_CLASSES)
+
+    def _load_train(self):
+        imgs: List[np.ndarray] = []
+        labels: List[int] = []
+        train_dir = os.path.join(self.root, "train")
+        for wnid, idx in sorted(self.wnid_to_idx.items(), key=lambda kv: kv[1]):
+            img_dir = os.path.join(train_dir, wnid, "images")
+            if not os.path.isdir(img_dir):
+                continue
+            files = sorted(os.listdir(img_dir))
+            if self.max_per_class:
+                files = files[: self.max_per_class]
+            for fn in files:
+                imgs.append(_decode_image(os.path.join(img_dir, fn)))
+                labels.append(idx)
+        if not imgs:
+            raise FileNotFoundError(f"no training images under {train_dir}")
+        return np.stack(imgs), np.asarray(labels, np.int64)
+
+    def _load_val(self):
+        """val/val_annotations.txt: ``filename\twnid\t…`` (reference
+        tiny_imagenet_data_loader.hpp val-annotation parsing)."""
+        val_dir = os.path.join(self.root, "val")
+        ann = os.path.join(val_dir, "val_annotations.txt")
+        imgs, labels = [], []
+        with open(ann, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split("\t")
+                if len(parts) < 2:
+                    continue
+                fn, wnid = parts[0], parts[1]
+                path = os.path.join(val_dir, "images", fn)
+                if wnid in self.wnid_to_idx and os.path.isfile(path):
+                    imgs.append(_decode_image(path))
+                    labels.append(self.wnid_to_idx[wnid])
+        if not imgs:
+            raise FileNotFoundError(f"no validation images under {val_dir}")
+        return np.stack(imgs), np.asarray(labels, np.int64)

@@ -1,0 +1,80 @@
+"""UJIIndoorLoc WiFi RSSI regression loader.
+
+Reference equivalent: the UJI indoor-positioning CSV loader
+(``include/data_loading/wifi_data_loader.hpp:27-461``): RSSI feature columns
+where the sentinel 100 (and raw 0) means "not detected" and is remapped to
+−100 dBm (:107-112), regression targets are the trailing longitude/latitude
+columns (:92-98), with per-column target mean/std normalization stored for
+de-normalization (:43-44).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .loader import BaseDataLoader
+
+NOT_DETECTED = -100.0
+
+
+class UJIWiFiDataLoader(BaseDataLoader):
+    def __init__(self, csv_path: str, num_targets: int = 2,
+                 normalize_targets: bool = True, **kw):
+        kw.setdefault("drop_last", False)
+        super().__init__(**kw)
+        self.csv_path = csv_path
+        self.num_targets = int(num_targets)
+        self.normalize_targets = bool(normalize_targets)
+        self.target_means: Optional[np.ndarray] = None
+        self.target_stds: Optional[np.ndarray] = None
+
+    def load_data(self) -> None:
+        if not os.path.isfile(self.csv_path):
+            raise FileNotFoundError(self.csv_path)
+        rows = []
+        with open(self.csv_path, "r", encoding="utf-8") as f:
+            reader = csv.reader(f)
+            header = next(reader, None)
+            for row in reader:
+                if row:
+                    rows.append(row)
+        if not rows:
+            raise ValueError(f"{self.csv_path}: empty")
+        ncols = len(rows[0])
+        feat_end = ncols - self.num_targets
+
+        feats = np.empty((len(rows), feat_end), np.float32)
+        targets = np.empty((len(rows), self.num_targets), np.float32)
+        for i, row in enumerate(rows):
+            for j in range(feat_end):
+                try:
+                    v = float(row[j])
+                except ValueError:
+                    v = NOT_DETECTED
+                # sentinel remap (wifi_data_loader.hpp:107-112)
+                if v == 100.0 or v == 0.0:
+                    v = NOT_DETECTED
+                feats[i, j] = v
+            for j in range(self.num_targets):
+                try:
+                    targets[i, j] = float(row[feat_end + j])
+                except ValueError:
+                    targets[i, j] = 0.0
+
+        # scale RSSI into [0,1]-ish range: (-100..0 dBm) → (0..1)
+        feats = (feats - NOT_DETECTED) / (-NOT_DETECTED)
+        if self.normalize_targets:
+            self.target_means = targets.mean(axis=0)
+            self.target_stds = targets.std(axis=0) + 1e-8
+            targets = (targets - self.target_means) / self.target_stds
+        self._x = feats
+        self._y = targets
+
+    def denormalize_targets(self, y: np.ndarray) -> np.ndarray:
+        if self.target_means is None:
+            return y
+        return y * self.target_stds + self.target_means

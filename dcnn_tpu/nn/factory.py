@@ -1,0 +1,43 @@
+"""String-keyed layer registry + JSON config materialization.
+
+Reference equivalent: ``LayerFactory`` (``include/nn/layers.hpp:115-296``) —
+the registry that lets a pipeline worker materialize its stage model from a
+JSON config message (``pipeline_stage.hpp:231-289``). Same role here: the
+pipeline coordinator ships ``Sequential.get_config()`` dicts; workers rebuild
+with ``layer_from_config``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Type
+
+from .layer import Layer
+
+_REGISTRY: Dict[str, Type[Layer]] = {}
+
+
+def register_layer(type_name: str) -> Callable[[Type[Layer]], Type[Layer]]:
+    def deco(cls: Type[Layer]) -> Type[Layer]:
+        cls.type_name = type_name
+        _REGISTRY[type_name] = cls
+        return cls
+    return deco
+
+
+def layer_from_config(cfg: Dict[str, Any]) -> Layer:
+    ty = cfg.get("type")
+    if ty not in _REGISTRY:
+        raise ValueError(f"unknown layer type {ty!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[ty].from_config(cfg)
+
+
+class LayerFactory:
+    """Class-style façade over the registry (reference API shape)."""
+
+    @staticmethod
+    def create(cfg: Dict[str, Any]) -> Layer:
+        return layer_from_config(cfg)
+
+    @staticmethod
+    def registered() -> Dict[str, Type[Layer]]:
+        return dict(_REGISTRY)

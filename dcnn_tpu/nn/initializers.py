@@ -1,0 +1,33 @@
+"""Parameter initializers.
+
+Reference parity: conv and dense weights AND biases use
+``Uniform(-bound, bound)`` with ``bound = 1/sqrt(fan_in)`` (the PyTorch
+default Kaiming-uniform; ``conv2d_layer.tpp:71-85``,
+``dense_layer.tpp``). BatchNorm/GroupNorm start at gamma=1, beta=0.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def kaiming_uniform(key: jax.Array, shape: Sequence[int], fan_in: int,
+                    dtype=jnp.float32) -> jax.Array:
+    bound = 1.0 / math.sqrt(float(fan_in))
+    return jax.random.uniform(key, tuple(shape), dtype=dtype, minval=-bound, maxval=bound)
+
+
+def conv_fan_in(in_channels: int, kernel_hw: Tuple[int, int]) -> int:
+    return in_channels * kernel_hw[0] * kernel_hw[1]
+
+
+def zeros(shape, dtype=jnp.float32) -> jax.Array:
+    return jnp.zeros(shape, dtype)
+
+
+def ones(shape, dtype=jnp.float32) -> jax.Array:
+    return jnp.ones(shape, dtype)

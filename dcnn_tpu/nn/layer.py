@@ -1,0 +1,111 @@
+"""Layer base classes.
+
+Reference equivalent: ``Layer<T>`` (``include/nn/layers_impl/base_layer.hpp:37``)
+with virtual ``forward/backward(…, micro_batch_id)``, ``parameters()``/
+``gradients()``, FLOP estimators ``forward_complexity``/``backward_complexity``
+(consumed by the partitioner), ``compute_output_shape``, clone/serialize, and
+the ``ParameterizedLayer``/``StatelessLayer`` split
+(``parameterized_layer.hpp:17-29``, ``stateless_layer.hpp``).
+
+TPU-native differences:
+
+- A layer is an immutable spec. ``init(key, input_shape)`` returns
+  ``(params, state)`` pytrees; ``apply(params, state, x, training, rng)``
+  returns ``(y, new_state)`` and is pure/jittable.
+- No ``backward``: ``jax.vjp(apply)`` is the backward. The reference's
+  per-microbatch caches (conv col buffers, pool argmax, BN saved stats —
+  SURVEY.md §1 "Microbatch-ID plumbing") become vjp residuals owned by the
+  pipeline schedule, not the layer.
+- Shapes are per-sample (no batch dim): ``(C, H, W)`` for image layers,
+  ``(features,)`` after Flatten — same convention the reference's
+  SequentialBuilder uses for shape inference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+Shape = Tuple[int, ...]
+
+
+class Layer:
+    """Immutable layer spec; subclasses define init/apply/output_shape."""
+
+    # registry key; subclasses override (reference LayerFactory keys, layers.hpp:115)
+    type_name: str = "layer"
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or self.type_name
+
+    # -- functional interface --
+    def init(self, key: jax.Array, input_shape: Shape) -> Tuple[Params, State]:
+        del key, input_shape
+        return {}, {}
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: jax.Array,
+        *,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, State]:
+        raise NotImplementedError
+
+    # -- shape / cost metadata --
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+    def forward_complexity(self, input_shape: Shape) -> int:
+        """Per-sample forward FLOP estimate (reference
+        ``base_layer.hpp:60-66``); drives the FLOP-balanced partitioner."""
+        del input_shape
+        return 0
+
+    def backward_complexity(self, input_shape: Shape) -> int:
+        # Backward ≈ 2× forward for conv/dense (two GEMMs vs one); subclasses
+        # with a better estimate override.
+        return 2 * self.forward_complexity(input_shape)
+
+    def param_count(self, input_shape: Shape) -> int:
+        return 0
+
+    # -- config round-trip (reference LayerConfig JSON, layers.hpp:21-113) --
+    def get_config(self) -> Dict[str, Any]:
+        return {"type": self.type_name, "name": self.name}
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "Layer":
+        kwargs = {k: v for k, v in cfg.items() if k != "type"}
+        return cls(**kwargs)
+
+    def __repr__(self) -> str:
+        cfg = {k: v for k, v in self.get_config().items() if k not in ("type", "name")}
+        args = ", ".join(f"{k}={v}" for k, v in cfg.items())
+        return f"{type(self).__name__}({args})"
+
+
+class ParameterizedLayer(Layer):
+    """Marker base for layers owning trainable parameters
+    (reference ``parameterized_layer.hpp:17``)."""
+
+    has_params = True
+
+
+class StatelessLayer(Layer):
+    """Marker base for layers with neither params nor state
+    (reference ``stateless_layer.hpp``)."""
+
+    has_params = False
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.forward(x, training=training, rng=rng), state
+
+    def forward(self, x: jax.Array, *, training: bool = False,
+                rng: Optional[jax.Array] = None) -> jax.Array:
+        raise NotImplementedError

@@ -1,0 +1,130 @@
+"""Residual block.
+
+Reference equivalent: ``ResidualBlock``
+(``include/nn/blocks_impl/residual_block.hpp:30-170``): main path = arbitrary
+layer list, shortcut = identity or projection layer list,
+``out = final_activation(F(x) + s(x))``. The reference caches the
+pre-activation sum and input shape per microbatch for its hand-written
+backward (:36-40, :145-152); here those residuals are owned by autodiff.
+
+JSON serialization recurses into nested layer configs exactly like the
+reference's recursive ``residual_block`` handling in the factory
+(``layers.hpp:228-287``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..ops import activations as act_ops
+from .factory import layer_from_config, register_layer
+from .layer import Layer, Params, Shape, State
+
+
+@register_layer("residual_block")
+class ResidualBlock(Layer):
+    has_params = True
+
+    def __init__(self, layers: Sequence[Layer], shortcut: Sequence[Layer] = (),
+                 activation: str = "relu", name: Optional[str] = None):
+        super().__init__(name)
+        self.layers: List[Layer] = list(layers)
+        self.shortcut: List[Layer] = list(shortcut)
+        self.activation = activation.lower()
+        if self.activation not in act_ops.ACTIVATIONS:
+            raise ValueError(f"unknown activation {activation!r}")
+
+    # -- functional interface --
+    def init(self, key, input_shape):
+        keys = jax.random.split(key, len(self.layers) + max(len(self.shortcut), 1))
+        main_params, main_state = [], []
+        shape = input_shape
+        for i, layer in enumerate(self.layers):
+            p, s = layer.init(keys[i], shape)
+            main_params.append(p)
+            main_state.append(s)
+            shape = layer.output_shape(shape)
+        short_params, short_state = [], []
+        sshape = input_shape
+        for i, layer in enumerate(self.shortcut):
+            p, s = layer.init(keys[len(self.layers) + i], sshape)
+            short_params.append(p)
+            short_state.append(s)
+            sshape = layer.output_shape(sshape)
+        if sshape != shape:
+            raise ValueError(
+                f"{self.name}: main path output {shape} != shortcut output {sshape}")
+        return ({"main": tuple(main_params), "shortcut": tuple(short_params)},
+                {"main": tuple(main_state), "shortcut": tuple(short_state)})
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        h = x
+        new_main = []
+        for i, layer in enumerate(self.layers):
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            h, s = layer.apply(params["main"][i], state["main"][i], h,
+                               training=training, rng=sub_rng)
+            new_main.append(s)
+        s_out = x
+        new_short = []
+        for i, layer in enumerate(self.shortcut):
+            sub_rng = jax.random.fold_in(rng, 1000 + i) if rng is not None else None
+            s_out, s = layer.apply(params["shortcut"][i], state["shortcut"][i], s_out,
+                                   training=training, rng=sub_rng)
+            new_short.append(s)
+        out = act_ops.ACTIVATIONS[self.activation](h + s_out)
+        return out, {"main": tuple(new_main), "shortcut": tuple(new_short)}
+
+    # -- metadata --
+    def output_shape(self, input_shape):
+        shape = input_shape
+        for layer in self.layers:
+            shape = layer.output_shape(shape)
+        return shape
+
+    def forward_complexity(self, input_shape):
+        total = 0
+        shape = input_shape
+        for layer in self.layers:
+            total += layer.forward_complexity(shape)
+            shape = layer.output_shape(shape)
+        sshape = input_shape
+        for layer in self.shortcut:
+            total += layer.forward_complexity(sshape)
+            sshape = layer.output_shape(sshape)
+        n = 1
+        for d in shape:
+            n *= d
+        return total + 2 * n  # add + activation
+
+    def param_count(self, input_shape):
+        total = 0
+        shape = input_shape
+        for layer in self.layers:
+            total += layer.param_count(shape)
+            shape = layer.output_shape(shape)
+        sshape = input_shape
+        for layer in self.shortcut:
+            total += layer.param_count(sshape)
+            sshape = layer.output_shape(sshape)
+        return total
+
+    # -- config --
+    def get_config(self) -> Dict[str, Any]:
+        return {
+            "type": self.type_name, "name": self.name,
+            "activation": self.activation,
+            "layers": [l.get_config() for l in self.layers],
+            "shortcut": [l.get_config() for l in self.shortcut],
+        }
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "ResidualBlock":
+        return cls(
+            layers=[layer_from_config(c) for c in cfg["layers"]],
+            shortcut=[layer_from_config(c) for c in cfg.get("shortcut", [])],
+            activation=cfg.get("activation", "relu"),
+            name=cfg.get("name"),
+        )

@@ -1,0 +1,38 @@
+"""Functional op layer — the TPU analog of the reference kernel layer.
+
+Reference equivalent: ``include/ops/ops.hpp`` (~35 elementwise/reduction ops
+dispatched CPU-vs-CUDA, each returning an async ``Task``) plus the per-layer
+kernel files under ``src/nn/layers_impl/{cpu,cuda}/`` (SURVEY.md §2.2).
+
+On TPU every op here is a pure jittable function: XLA fuses elementwise chains
+into matmul/conv epilogues, so the reference's hand-written AVX2/CUDA kernels
+collapse to ``jnp`` expressions, and its Task/Flow async model collapses to
+XLA's async dispatch. Pallas kernels live in ``dcnn_tpu.ops.pallas`` and are
+used only where fusion measurably falls short.
+"""
+
+from . import elementwise
+from .activations import (
+    elu, leaky_relu, linear, relu, sigmoid, softmax, tanh,
+    ACTIVATIONS, apply_activation,
+)
+from .conv import conv2d, conv2d_input_grad, conv2d_weight_grad
+from .pool import avg_pool2d, max_pool2d
+from .norm import batch_norm, group_norm
+from .losses import (
+    cross_entropy, softmax_cross_entropy, log_softmax_cross_entropy,
+    mse_loss, mae_loss, huber_loss, LOSSES,
+)
+from .metrics import accuracy, correct_count
+
+__all__ = [
+    "elementwise",
+    "relu", "leaky_relu", "elu", "sigmoid", "tanh", "softmax", "linear",
+    "ACTIVATIONS", "apply_activation",
+    "conv2d", "conv2d_input_grad", "conv2d_weight_grad",
+    "max_pool2d", "avg_pool2d",
+    "batch_norm", "group_norm",
+    "cross_entropy", "softmax_cross_entropy", "log_softmax_cross_entropy",
+    "mse_loss", "mae_loss", "huber_loss", "LOSSES",
+    "accuracy", "correct_count",
+]

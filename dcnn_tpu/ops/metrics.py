@@ -1,0 +1,24 @@
+"""Accuracy metrics.
+
+Reference equivalent: argmax-match count/accuracy kernels on CPU and GPU with
+a device dispatch (``include/utils/utils_extended.hpp:11-40``,
+``src/utils/accuracy_impl/{cpu,cuda}/accuracy.*``). On TPU both are one fused
+argmax-compare-reduce that stays on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def correct_count(predictions: jax.Array, targets: jax.Array) -> jax.Array:
+    """Number of rows where argmax(pred) == argmax(target). Targets may be
+    one-hot (rank 2) or integer class labels (rank 1)."""
+    pred_cls = jnp.argmax(predictions, axis=-1)
+    target_cls = targets if targets.ndim == 1 else jnp.argmax(targets, axis=-1)
+    return jnp.sum(pred_cls == target_cls)
+
+
+def accuracy(predictions: jax.Array, targets: jax.Array) -> jax.Array:
+    return correct_count(predictions, targets) / predictions.shape[0]

@@ -1,0 +1,95 @@
+"""Normalization ops.
+
+Reference equivalent: fused BatchNorm forward (mean/inv-std/running-stat
+update/normalize), fused backward, inference path
+(``src/nn/layers_impl/cpu/batchnorm_ops.cpp``, ``cuda/batchnorm_ops.cu``,
+layer ``batchnorm_layer.tpp``) and the per-group GroupNorm twins
+(``groupnorm_ops.cpp``/``.cu``). Defaults for parity: eps 1e-5, BN momentum
+0.1 (``batchnorm_layer.hpp:67``, ``groupnorm_layer.hpp:56``).
+
+XLA fuses the normalize-scale-shift chain into neighboring ops, so these are
+plain jnp expressions; backward comes from autodiff (numerically the same
+reduction tree as the reference's hand-fused backward).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    *,
+    training: bool,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    data_format: str = "NCHW",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (y, new_running_mean, new_running_var).
+
+    Training mode normalizes with batch statistics over (N,H,W) and updates
+    running stats as ``running = (1-momentum)*running + momentum*batch``
+    (reference semantics: batchnorm_layer.tpp, momentum 0.1). Eval mode uses
+    running stats. The reference computes BN per microbatch independently
+    (SURVEY.md §7 hard part 4); callers get that behavior for free by invoking
+    this once per microbatch.
+    """
+    c_axis = 1 if data_format == "NCHW" else 3
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+
+    if training:
+        mean = jnp.mean(x, axis=reduce_axes)
+        # Biased variance for normalization (like the reference's fused kernel);
+        # unbiased correction applied to the running estimate like torch.
+        var = jnp.var(x, axis=reduce_axes)
+        n = x.size // x.shape[c_axis]
+        unbiased = var * (n / max(n - 1, 1))
+        new_mean = (1 - momentum) * running_mean + momentum * mean
+        new_var = (1 - momentum) * running_var + momentum * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean.reshape(shape)) * inv.reshape(shape)
+    y = y * gamma.reshape(shape) + beta.reshape(shape)
+    return y, new_mean, new_var
+
+
+def group_norm(
+    x: jax.Array,
+    gamma: Optional[jax.Array],
+    beta: Optional[jax.Array],
+    num_groups: int,
+    *,
+    eps: float = 1e-5,
+    data_format: str = "NCHW",
+) -> jax.Array:
+    """Per-sample, per-group normalization over (C/G, H, W)
+    (reference ``groupnorm_ops.cpp``; eps 1e-5)."""
+    if data_format == "NHWC":
+        x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+        y = group_norm(x_nchw, gamma, beta, num_groups, eps=eps, data_format="NCHW")
+        return jnp.transpose(y, (0, 2, 3, 1))
+
+    n, c, h, w = x.shape
+    if c % num_groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
+    xg = x.reshape(n, num_groups, c // num_groups, h, w)
+    mean = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(n, c, h, w)
+    if gamma is not None:
+        y = y * gamma.reshape(1, c, 1, 1)
+    if beta is not None:
+        y = y + beta.reshape(1, c, 1, 1)
+    return y

@@ -1,0 +1,18 @@
+"""Pallas TPU kernels.
+
+Policy (SURVEY.md §7 stage 4): XLA's fusion already covers the reference's
+hand-written kernel inventory (elementwise chains fuse into conv/matmul
+epilogues; reductions fuse with normalize steps), so Pallas is reserved for
+ops where measured profiles show fusion falling short. Kernels here must
+match their XLA-composed references bit-for-bit in tests (run in interpret
+mode on CPU, compiled on TPU).
+
+Current kernels:
+- ``fused_scale_bias_relu`` — y = max(x*scale + bias, 0) per channel, the
+  BN-inference + ReLU epilogue (reference fuses this on CPU/CUDA in
+  ``batchnorm_ops`` + activation kernels).
+"""
+
+from .fused import fused_scale_bias_relu
+
+__all__ = ["fused_scale_bias_relu"]

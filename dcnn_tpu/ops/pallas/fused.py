@@ -1,0 +1,54 @@
+"""Fused per-channel scale/bias/ReLU Pallas kernel.
+
+The BN-inference epilogue ``y = max(x * scale + bias, 0)`` — the op chain the
+reference fuses by hand in its CPU/CUDA batchnorm+activation kernels
+(``src/nn/layers_impl/cpu/batchnorm_ops.cpp`` inference path + relu kernel).
+XLA usually fuses this too; the kernel exists as the template for the
+framework's Pallas surface (grid/block layout, NHWC channel-lane tiling) and
+is validated bit-for-bit against the jnp composition in tests.
+
+Layout: NHWC with C on the lane dimension (128-wide) — the TPU-native choice;
+callers in NCHW transpose at the boundary (XLA folds the transpose).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, scale_ref, bias_ref, o_ref):
+    o_ref[:] = jnp.maximum(x_ref[:] * scale_ref[:] + bias_ref[:], 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_scale_bias_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                          *, interpret: bool | None = None) -> jax.Array:
+    """``max(x*scale + bias, 0)`` with scale/bias broadcast over the last
+    (channel) axis. ``x``: (..., C); ``scale``/``bias``: (C,)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    orig_shape = x.shape
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    n = x2.shape[0]
+    # row-block the flattened batch; full channel width per block
+    block_rows = min(n, 512)
+    grid = (pl.cdiv(n, block_rows),)
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((n, c), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2, scale, bias)
+    return out.reshape(orig_shape)

@@ -1,0 +1,94 @@
+"""Pooling ops.
+
+Reference equivalent: MaxPool/AvgPool forward + backward-scatter kernels with
+an argmax-index cache per microbatch (``src/nn/layers_impl/cpu/maxpool_ops.cpp``,
+``avgpool_ops.cpp`` and CUDA twins; layers ``maxpool2d_layer.tpp:264``,
+``avgpool2d_layer.tpp:253``).
+
+On TPU both are ``lax.reduce_window`` — XLA generates the backward scatter from
+the autodiff transpose rule, so no argmax cache is needed (its job is done by
+the VJP residuals).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IntOrPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOrPair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+def _window(kernel, stride, padding, data_format):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    if data_format == "NCHW":
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    elif data_format == "NHWC":
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    else:
+        raise ValueError(f"unsupported data_format {data_format!r}")
+    return dims, strides, pads
+
+
+def max_pool2d(
+    x: jax.Array,
+    kernel: IntOrPair,
+    stride: IntOrPair | None = None,
+    padding: IntOrPair = 0,
+    *,
+    data_format: str = "NCHW",
+) -> jax.Array:
+    dims, strides, pads = _window(kernel, stride, padding, data_format)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+
+
+def avg_pool2d(
+    x: jax.Array,
+    kernel: IntOrPair,
+    stride: IntOrPair | None = None,
+    padding: IntOrPair = 0,
+    *,
+    data_format: str = "NCHW",
+    count_include_pad: bool = True,
+) -> jax.Array:
+    """Average pool. The reference divides by the full window size including
+    padded cells (``avgpool_ops.cpp``), i.e. ``count_include_pad=True`` — keep
+    that default for parity."""
+    dims, strides, pads = _window(kernel, stride, padding, data_format)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    kh, kw = _pair(kernel)
+    if count_include_pad:
+        return summed / (kh * kw)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+    return summed / counts
+
+
+def global_avg_pool2d(x: jax.Array, *, data_format: str = "NCHW") -> jax.Array:
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return jnp.mean(x, axis=axes, keepdims=True)
+
+
+def pool_output_shape(
+    input_hw: Tuple[int, int],
+    kernel: IntOrPair,
+    stride: IntOrPair | None = None,
+    padding: IntOrPair = 0,
+) -> Tuple[int, int]:
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    return ((input_hw[0] + 2 * ph - kh) // sh + 1, (input_hw[1] + 2 * pw - kw) // sw + 1)

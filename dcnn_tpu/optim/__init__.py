@@ -1,0 +1,19 @@
+"""Optimizers and LR schedulers.
+
+Reference equivalent: ``include/nn/optimizers.hpp`` (SGD/Adam/AdamW with fused
+update kernels) and ``include/nn/schedulers.hpp`` (10 scheduler families).
+"""
+
+from .optimizers import SGD, Adam, AdamW, Optimizer, OptimizerFactory
+from .schedulers import (
+    StepLR, MultiStepLR, ExponentialLR, CosineAnnealingLR,
+    CosineAnnealingWarmRestarts, LinearWarmup, WarmupCosineAnnealing,
+    ReduceLROnPlateau, PolynomialLR, OneCycleLR, SchedulerFactory,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Adam", "AdamW", "OptimizerFactory",
+    "StepLR", "MultiStepLR", "ExponentialLR", "CosineAnnealingLR",
+    "CosineAnnealingWarmRestarts", "LinearWarmup", "WarmupCosineAnnealing",
+    "ReduceLROnPlateau", "PolynomialLR", "OneCycleLR", "SchedulerFactory",
+]

@@ -1,0 +1,153 @@
+"""Optimizers as pure pytree transforms.
+
+Reference equivalent: ``Optimizer/SGD/Adam`` + fused CPU/CUDA update kernels
+(``include/nn/optimizers.hpp:89-306``,
+``src/nn/optimizers_impl/cpu/{sgd,adam}_kernels.cpp``). Update rules are
+reproduced exactly:
+
+- SGD: ``p -= lr·g``; momentum: ``v = μ·v − lr·g; p += v``
+  (sgd_kernels.cpp:16-30 — note velocity carries the lr, PyTorch-style
+  "dampened" form is NOT used).
+- Adam: m/v moments with bias correction ``m̂ = m/(1−β₁ᵗ)``; non-decoupled
+  weight decay is added to the *update* (not the gradient), decoupled (AdamW)
+  multiplies params by ``(1 − wd·lr)`` — both exactly as
+  adam_kernels.cpp:29-56.
+
+TPU-native shape: instead of mutating attached tensors, each optimizer is
+``init(params) -> opt_state`` + ``update(grads, opt_state, params, lr) ->
+(new_params, new_opt_state)``, jit-safe and pipeline-shardable. ``lr`` is a
+traced argument so LR schedules don't trigger recompilation. Opt state is a
+pytree → it checkpoints (the reference drops Adam moments on save,
+SURVEY.md §5.4; we do not).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+OptState = Dict[str, Any]
+
+
+class Optimizer:
+    """Base: stateless spec; all state is in the opt_state pytree."""
+
+    def __init__(self, learning_rate: float = 0.01):
+        self.learning_rate = float(learning_rate)
+
+    def init(self, params) -> OptState:
+        raise NotImplementedError
+
+    def update(self, grads, opt_state: OptState, params, lr: Optional[jax.Array] = None,
+               ) -> Tuple[Any, OptState]:
+        raise NotImplementedError
+
+    # -- config round-trip (reference OptimizerConfig JSON, optimizers.hpp:25-87) --
+    def get_config(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0):
+        super().__init__(learning_rate)
+        self.momentum = float(momentum)
+
+    def init(self, params) -> OptState:
+        if self.momentum > 0.0:
+            return {"velocity": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        return {}
+
+    def update(self, grads, opt_state, params, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        if self.momentum > 0.0:
+            mu = self.momentum
+            new_v = jax.tree_util.tree_map(
+                lambda v, g: mu * v - lr * g, opt_state["velocity"], grads)
+            new_params = jax.tree_util.tree_map(lambda p, v: p + v, params, new_v)
+            return new_params, {"velocity": new_v}
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, {}
+
+    def get_config(self):
+        return {"type": "sgd", "learning_rate": self.learning_rate, "momentum": self.momentum}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0, decouple_weight_decay: bool = False):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2 = float(beta1), float(beta2)
+        self.epsilon = float(epsilon)
+        self.weight_decay = float(weight_decay)
+        self.decouple_weight_decay = bool(decouple_weight_decay)
+
+    def init(self, params) -> OptState:
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"m": zeros(), "v": zeros(), "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
+        t = opt_state["t"] + 1
+        bc1 = 1.0 - b1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** t.astype(jnp.float32)
+
+        new_m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
+                                       opt_state["m"], grads)
+        new_v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                                       opt_state["v"], grads)
+
+        def step(p, m, v):
+            update = lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if wd > 0.0:
+                if self.decouple_weight_decay:
+                    p = p - wd * lr * p            # AdamW (adam_kernels.cpp:48)
+                else:
+                    update = update + wd * lr * p  # L2-in-update (adam_kernels.cpp:51)
+            return p - update
+
+        new_params = jax.tree_util.tree_map(step, params, new_m, new_v)
+        return new_params, {"m": new_m, "v": new_v, "t": t}
+
+    def name(self):
+        return "AdamW" if self.decouple_weight_decay else "Adam"
+
+    def get_config(self):
+        return {"type": "adamw" if self.decouple_weight_decay else "adam",
+                "learning_rate": self.learning_rate, "beta1": self.beta1,
+                "beta2": self.beta2, "epsilon": self.epsilon,
+                "weight_decay": self.weight_decay,
+                "decouple_weight_decay": self.decouple_weight_decay}
+
+
+def AdamW(learning_rate: float = 0.001, beta1: float = 0.9, beta2: float = 0.999,
+          epsilon: float = 1e-8, weight_decay: float = 0.01) -> Adam:
+    """AdamW = Adam with decoupled decay (reference names it the same way,
+    optimizers.hpp:241)."""
+    return Adam(learning_rate, beta1, beta2, epsilon, weight_decay,
+                decouple_weight_decay=True)
+
+
+class OptimizerFactory:
+    """String/JSON-keyed construction (reference
+    ``OptimizerFactory::create_from_config``, optimizers.hpp:285-306)."""
+
+    @staticmethod
+    def create_from_config(cfg: Dict[str, Any]) -> Optimizer:
+        ty = cfg.get("type", "sgd").lower()
+        kw = {k: v for k, v in cfg.items() if k != "type"}
+        if ty == "sgd":
+            return SGD(**kw)
+        if ty == "adam":
+            return Adam(**kw)
+        if ty == "adamw":
+            kw.pop("decouple_weight_decay", None)
+            return AdamW(**kw)
+        raise ValueError(f"unknown optimizer type {ty!r}")

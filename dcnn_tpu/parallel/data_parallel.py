@@ -1,0 +1,85 @@
+"""Data-parallel (and spatial-parallel) training over a device mesh.
+
+No reference analog — the reference has **no** cross-device data parallelism
+(SURVEY.md §2.4 "Explicitly absent"); this is the capability uplift that
+comes free with jit-over-Mesh: annotate the batch axis sharding and XLA
+inserts the gradient all-reduce over ICI.
+
+Spatial sharding (the CNN analog of sequence/context parallelism): shard H of
+the activations over a mesh axis and XLA GSPMD automatically inserts the
+conv halo exchanges — the role ring-attention plays for attention models.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS
+from ..nn.sequential import Sequential
+from ..optim.optimizers import Optimizer
+from ..train.trainer import TrainState, make_train_step
+
+
+def replicate(tree, mesh: Mesh):
+    """Place a pytree replicated over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS, spatial_axis: Optional[str] = None,
+                spatial_dim: int = 2):
+    """Shard array(s) batch-dim over ``axis`` (and optionally a spatial dim
+    over ``spatial_axis`` — GSPMD handles conv halos)."""
+    def put(x):
+        spec = [None] * x.ndim
+        spec[0] = axis
+        if spatial_axis is not None and x.ndim > spatial_dim:
+            spec[spatial_dim] = spatial_axis
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree_util.tree_map(put, batch)
+
+
+def make_data_parallel_train_step(model: Sequential, loss_fn: Callable,
+                                  optimizer: Optimizer, mesh: Mesh,
+                                  num_microbatches: int = 1,
+                                  spatial_axis: Optional[str] = None):
+    """jit train step with params replicated and batch sharded over
+    ``mesh['data']``. The returned step has identical semantics to the
+    single-chip ``make_train_step``; XLA adds the psum for grads.
+
+    NOTE on BN parity: batch statistics are computed over the *global* batch
+    (XLA reduces across the data axis automatically because the reduction
+    crosses a sharded axis) — numerically this matches single-device
+    full-batch BN, which is *better* than per-shard stats.
+    """
+    base_step = make_train_step(model, loss_fn, optimizer, num_microbatches,
+                                jit=False)
+
+    # batch rank = per-sample rank + 1 (4-D for images, 2-D for flat MLPs)
+    x_rank = len(model.input_shape) + 1 if model.input_shape is not None else 4
+    x_spec = [DATA_AXIS] + [None] * (x_rank - 1)
+    if spatial_axis is not None:
+        if x_rank != 4:
+            raise ValueError("spatial_axis requires 4-D image input")
+        x_spec[2] = spatial_axis
+
+    replicated = NamedSharding(mesh, P())
+    x_sharding = NamedSharding(mesh, P(*x_spec))
+    y_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    step = jax.jit(
+        base_step,
+        in_shardings=(replicated, x_sharding, y_sharding, replicated, replicated),
+        out_shardings=(replicated, replicated, y_sharding),
+        donate_argnums=(0,),
+    )
+
+    def wrapped(ts: TrainState, x, y, rng, lr):
+        return step(ts, x, y, rng, jax.numpy.asarray(lr, jax.numpy.float32))
+
+    return wrapped

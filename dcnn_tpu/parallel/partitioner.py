@@ -1,0 +1,89 @@
+"""Model partitioners for pipeline parallelism.
+
+Reference equivalent: ``Partitioner<T>`` interface
+(``include/partitioner/partitioner.hpp:6-13``) and ``NaivePartitioner`` =
+even layer-count split (``naive_partitioner.hpp:13-33``). The reference
+planned a FLOP-balancing partitioner using ``Layer::forward_complexity``
+(``TODO:2``) but never built it — ``FlopBalancedPartitioner`` here is that
+design, driven by the same per-layer complexity estimates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..nn.sequential import Sequential
+
+Partition = Tuple[int, int]  # [start, end) layer range
+
+
+class Partitioner:
+    def get_partitions(self, model: Sequential, num_stages: int) -> List[Partition]:
+        raise NotImplementedError
+
+    @staticmethod
+    def _validate(model: Sequential, num_stages: int) -> None:
+        if num_stages < 1:
+            raise ValueError("num_stages must be >= 1")
+        if num_stages > len(model.layers):
+            raise ValueError(
+                f"cannot split {len(model.layers)} layers into {num_stages} stages")
+
+
+class NaivePartitioner(Partitioner):
+    """Even layer-count split (reference naive_partitioner.hpp:13-33):
+    first ``rem`` stages get one extra layer."""
+
+    def get_partitions(self, model: Sequential, num_stages: int) -> List[Partition]:
+        self._validate(model, num_stages)
+        n = len(model.layers)
+        base, rem = divmod(n, num_stages)
+        parts: List[Partition] = []
+        start = 0
+        for s in range(num_stages):
+            size = base + (1 if s < rem else 0)
+            parts.append((start, start + size))
+            start += size
+        return parts
+
+
+class FlopBalancedPartitioner(Partitioner):
+    """Split minimizing per-stage FLOP imbalance.
+
+    Uses per-layer ``forward_complexity + backward_complexity`` (the
+    estimators the reference exposes for exactly this purpose,
+    base_layer.hpp:60-66) and a greedy prefix walk targeting equal
+    cumulative-cost slices. Residual blocks are atomic (the reference also
+    never splits inside a block)."""
+
+    def get_partitions(self, model: Sequential, num_stages: int) -> List[Partition]:
+        self._validate(model, num_stages)
+        shapes = model.layer_shapes()
+        costs = [
+            layer.forward_complexity(shape) + layer.backward_complexity(shape) + 1
+            for layer, shape in zip(model.layers, shapes)
+        ]
+        total = sum(costs)
+        n = len(costs)
+        parts: List[Partition] = []
+        start = 0
+        acc = 0.0
+        for s in range(num_stages):
+            target = total * (s + 1) / num_stages
+            end = start + 1  # at least one layer per stage
+            acc += costs[start]
+            # extend while staying closer to the target than stopping, and
+            # leaving enough layers for the remaining stages
+            while end < n - (num_stages - s - 1):
+                next_acc = acc + costs[end]
+                if abs(next_acc - target) <= abs(acc - target):
+                    acc = next_acc
+                    end += 1
+                else:
+                    break
+            parts.append((start, end))
+            start = end
+        # last stage must absorb any remainder
+        if parts[-1][1] != n:
+            parts[-1] = (parts[-1][0], n)
+        return parts

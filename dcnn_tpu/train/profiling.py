@@ -1,0 +1,113 @@
+"""Per-layer profiling.
+
+Reference equivalent: the µs-per-named-layer forward/backward maps +
+``print_profiling_summary`` table in ``Sequential``
+(``sequential.hpp:54-55,461-498,323-418``) with NORMAL (clear per batch) vs
+CUMULATIVE modes (``train.hpp:37,160-162``).
+
+On TPU, timing *inside* a jitted step is meaningless (XLA fuses across layer
+boundaries), so per-layer timing runs the layer chain eagerly layer-by-layer
+with ``block_until_ready`` — the same numbers the reference's
+per-layer-sync profiling produces, at the same cost model (a profiling run,
+not the training fast path). For production tracing, ``trace()`` wraps
+``jax.profiler`` for xprof/tensorboard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+from ..core.config import ProfilerType
+from ..nn.sequential import Sequential
+
+
+class LayerProfiler:
+    def __init__(self, mode: ProfilerType = ProfilerType.NORMAL):
+        self.mode = mode
+        self.forward_us: Dict[str, float] = defaultdict(float)
+        self.backward_us: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def clear(self) -> None:
+        self.forward_us.clear()
+        self.backward_us.clear()
+        self.counts.clear()
+
+    def maybe_clear_per_batch(self) -> None:
+        if self.mode == ProfilerType.NORMAL:
+            self.clear()
+
+    def profile_forward(self, model: Sequential, params, state, x, *,
+                        training: bool = False, rng=None):
+        """Run the model layer-by-layer, timing each (device-synced)."""
+        h = x
+        new_state = []
+        for i, layer in enumerate(model.layers):
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            t0 = time.perf_counter()
+            h, s = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
+            jax.block_until_ready(h)
+            self.forward_us[layer.name] += (time.perf_counter() - t0) * 1e6
+            self.counts[layer.name] += 1
+            new_state.append(s)
+        return h, tuple(new_state)
+
+    def profile_backward(self, model: Sequential, params, state, x, grad_out, *,
+                         training: bool = True, rng=None):
+        """Per-layer backward timing via per-layer vjp (mirrors the
+        reference's reverse loop timing, sequential.hpp:562-572)."""
+        # forward pass saving per-layer inputs
+        h = x
+        inputs = []
+        for i, layer in enumerate(model.layers):
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            inputs.append(h)
+            h, _ = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
+        g = grad_out
+        for i in reversed(range(len(model.layers))):
+            layer = model.layers[i]
+            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+
+            def fwd(p, xin):
+                y, _ = layer.apply(p, state[i], xin, training=training, rng=sub_rng)
+                return y
+
+            t0 = time.perf_counter()
+            _, vjp = jax.vjp(fwd, params[i], inputs[i])
+            gp, g = vjp(g)
+            jax.block_until_ready(g)
+            self.backward_us[layer.name] += (time.perf_counter() - t0) * 1e6
+        return g
+
+    def summary(self) -> str:
+        """Printable table (reference ``print_profiling_summary``,
+        sequential.hpp:323-418)."""
+        names = list(self.forward_us.keys())
+        for n in self.backward_us:
+            if n not in names:
+                names.append(n)
+        lines = [f"{'layer':<28} {'fwd µs':>12} {'bwd µs':>12} {'calls':>7}"]
+        tf = tb = 0.0
+        for n in names:
+            f, b = self.forward_us.get(n, 0.0), self.backward_us.get(n, 0.0)
+            tf += f
+            tb += b
+            lines.append(f"{n:<28} {f:>12.1f} {b:>12.1f} {self.counts.get(n, 0):>7}")
+        lines.append(f"{'TOTAL':<28} {tf:>12.1f} {tb:>12.1f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
+    """XLA-level trace for xprof/tensorboard (the TPU-native answer to the
+    reference's profiling commands, SURVEY.md §5.1)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

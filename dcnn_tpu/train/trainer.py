@@ -1,0 +1,304 @@
+"""Single-device (and data-parallel) training loops.
+
+Reference equivalent: ``include/nn/train.hpp`` — ``TrainingConfig`` (:46),
+``train_class_epoch`` (:108), ``validate_class_model`` (:172),
+``train_classification_model`` (:202: epoch loop, best-val snapshot save,
+per-epoch LR decay), regression twins (:311-481).
+
+TPU-native shape: one jitted ``train_step`` closes over the model spec /
+loss / optimizer; params/state/opt-state live in a ``TrainState`` pytree.
+Optional microbatch gradient accumulation runs as a ``lax.scan`` inside the
+step — BN statistics are computed per microbatch sequentially, matching the
+reference's per-microbatch BN semantics (SURVEY.md §7 hard part 4).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ProfilerType, TrainingConfig
+from ..nn.sequential import Sequential
+from ..ops.losses import get_loss
+from ..ops.metrics import correct_count
+from ..optim.optimizers import Optimizer
+from ..optim.schedulers import Scheduler
+from .checkpoint import save_checkpoint
+from .profiling import LayerProfiler
+
+
+@dataclass
+class TrainState:
+    """Everything that changes during training, as one pytree."""
+
+    params: Any
+    state: Any        # per-layer mutable state (BN running stats)
+    opt_state: Any
+    step: jax.Array   # int32 scalar
+
+    def tree_flatten(self):
+        return (self.params, self.state, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def create_train_state(model: Sequential, optimizer: Optimizer, key: jax.Array,
+                       input_shape=None) -> TrainState:
+    params, state = model.init(key, input_shape)
+    return TrainState(params=params, state=state,
+                      opt_state=optimizer.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
+                    num_microbatches: int = 1, donate: bool = True,
+                    jit: bool = True):
+    """Returns jitted ``step(ts, x, y, rng, lr) -> (ts, loss, logits)``.
+
+    With ``num_microbatches > 1`` the batch is split on the leading axis and
+    grads are accumulated with ``lax.scan`` (the single-jit analog of the
+    reference's microbatch streaming, tensor_ops.hpp:193-225)."""
+
+    def forward_loss(params, state, x, y, rng):
+        logits, new_state = model.apply(params, state, x, training=True, rng=rng)
+        return loss_fn(logits, y), (logits, new_state)
+
+    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+
+    def step(ts: TrainState, x, y, rng, lr):
+        # Shapes are static at trace time: a trailing partial batch (any
+        # drop_last=False loader) that doesn't divide evenly falls back to
+        # one whole-batch microbatch rather than crashing the reshape.
+        if num_microbatches == 1 or x.shape[0] % num_microbatches != 0:
+            (loss, (logits, new_state)), grads = grad_fn(ts.params, ts.state, x, y, rng)
+        else:
+            mb_x = x.reshape(num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:])
+            mb_y = y.reshape(num_microbatches, y.shape[0] // num_microbatches, *y.shape[1:])
+
+            def body(carry, mb):
+                state, grad_acc, loss_acc = carry
+                xi, yi, i = mb
+                (loss, (logits, new_state)), grads = grad_fn(
+                    ts.params, state, xi, yi, jax.random.fold_in(rng, i))
+                grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+                return (new_state, grad_acc, loss_acc + loss), logits
+
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
+            idx = jnp.arange(num_microbatches)
+            (new_state, grads, loss_sum), logits_all = jax.lax.scan(
+                body, (ts.state, zero_grads, 0.0), (mb_x, mb_y, idx))
+            grads = jax.tree_util.tree_map(lambda g: g / num_microbatches, grads)
+            loss = loss_sum / num_microbatches
+            logits = logits_all.reshape(x.shape[0], -1)
+
+        new_params, new_opt = optimizer.update(grads, ts.opt_state, ts.params, lr)
+        return (TrainState(new_params, new_state, new_opt, ts.step + 1), loss, logits)
+
+    if not jit:
+        return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+@functools.lru_cache(maxsize=64)
+def make_eval_step(model: Sequential, loss_fn: Callable):
+    """Jitted ``eval_step(params, state, x, y) -> (loss, correct)``
+    (reference ``validate_class_model``, train.hpp:172). Memoized on
+    (model, loss_fn) identity so per-epoch validation reuses one compiled
+    step instead of re-jitting every call."""
+
+    @jax.jit
+    def eval_step(params, state, x, y):
+        logits, _ = model.apply(params, state, x, training=False)
+        return loss_fn(logits, y), correct_count(logits, y)
+
+    return eval_step
+
+
+def evaluate_classification(model, params, state, loss_fn, loader,
+                            eval_step=None) -> Tuple[float, float]:
+    eval_step = eval_step if eval_step is not None else make_eval_step(model, loss_fn)
+    total_loss, total_correct, total_n = 0.0, 0, 0
+    for x, y in loader:
+        loss, correct = eval_step(params, state, jnp.asarray(x), jnp.asarray(y))
+        total_loss += float(loss) * x.shape[0]
+        total_correct += int(correct)
+        total_n += x.shape[0]
+    if total_n == 0:
+        return 0.0, 0.0
+    return total_loss / total_n, total_correct / total_n
+
+
+class Trainer:
+    """Epoch-loop driver (reference ``train_classification_model``,
+    train.hpp:202-308): per-epoch train/validate, best-val snapshot, LR decay
+    or scheduler, progress prints, optional per-layer profiling."""
+
+    def __init__(self, model: Sequential, optimizer: Optimizer,
+                 loss: Callable | str, config: Optional[TrainingConfig] = None,
+                 scheduler: Optional[Scheduler] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = get_loss(loss) if isinstance(loss, str) else loss
+        self.config = config or TrainingConfig()
+        self.scheduler = scheduler
+        self.profiler = (LayerProfiler(self.config.profiler)
+                         if self.config.profiler != ProfilerType.NONE else None)
+        self.train_step = make_train_step(model, self.loss_fn, optimizer,
+                                          self.config.num_microbatches)
+        self.eval_step = make_eval_step(model, self.loss_fn)
+        self.lr = self.config.learning_rate
+        self.history: list = []
+
+    def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
+                    epoch: int = 0) -> Tuple[TrainState, float, float]:
+        total_loss, total_correct, total_n, batches = 0.0, 0, 0, 0
+        t0 = time.perf_counter()
+        for bi, (x, y) in enumerate(loader):
+            x, y = jnp.asarray(x), jnp.asarray(y)
+            step_rng = jax.random.fold_in(rng, bi)
+            ts, loss, logits = self.train_step(ts, x, y, step_rng, self.lr)
+            total_loss += float(loss) * x.shape[0]
+            total_correct += int(correct_count(logits, y))
+            total_n += x.shape[0]
+            batches += 1
+            if self.config.progress_interval and (bi + 1) % self.config.progress_interval == 0:
+                dt = time.perf_counter() - t0
+                print(f"  epoch {epoch} batch {bi + 1}: loss {total_loss / total_n:.4f} "
+                      f"acc {total_correct / total_n:.4f} "
+                      f"({total_n / dt:.1f} samples/s)", flush=True)
+        return ts, (total_loss / max(total_n, 1)), (total_correct / max(total_n, 1))
+
+    def fit(self, ts: TrainState, train_loader, val_loader=None,
+            epochs: Optional[int] = None, seed: Optional[int] = None) -> TrainState:
+        cfg = self.config
+        epochs = epochs or cfg.epochs
+        rng = jax.random.PRNGKey(seed if seed is not None else cfg.seed)
+        best_val = -1.0
+        for epoch in range(1, epochs + 1):
+            if hasattr(train_loader, "shuffle"):
+                train_loader.shuffle(epoch)
+            epoch_rng = jax.random.fold_in(rng, epoch)
+            t0 = time.perf_counter()
+            ts, train_loss, train_acc = self.train_epoch(ts, train_loader, epoch_rng, epoch)
+            dt = time.perf_counter() - t0
+
+            val_loss = val_acc = None
+            if val_loader is not None:
+                val_loss, val_acc = evaluate_classification(
+                    self.model, ts.params, ts.state, self.loss_fn, val_loader,
+                    eval_step=self.eval_step)
+                # best-val snapshot (reference train.hpp:254-264)
+                if cfg.snapshot_dir and val_acc > best_val:
+                    best_val = val_acc
+                    save_checkpoint(
+                        os.path.join(cfg.snapshot_dir, self.model.name),
+                        self.model, ts.params, ts.state, ts.opt_state,
+                        self.optimizer,
+                        {"epoch": epoch, "val_acc": val_acc, "val_loss": val_loss})
+
+            self.history.append({"epoch": epoch, "train_loss": train_loss,
+                                 "train_acc": train_acc, "val_loss": val_loss,
+                                 "val_acc": val_acc, "seconds": dt, "lr": self.lr})
+            msg = (f"epoch {epoch}/{epochs}: train loss {train_loss:.4f} "
+                   f"acc {train_acc:.4f}")
+            if val_acc is not None:
+                msg += f" | val loss {val_loss:.4f} acc {val_acc:.4f}"
+            print(msg + f" | {dt:.1f}s lr {self.lr:.2e}", flush=True)
+
+            # LR schedule: scheduler wins; else multiplicative decay
+            # (reference train.hpp:282-288).
+            if self.scheduler is not None:
+                self.lr = self.scheduler.step(val_loss if val_loss is not None else train_loss)
+            elif cfg.lr_decay_factor != 1.0 and epoch % cfg.lr_decay_interval == 0:
+                self.lr *= cfg.lr_decay_factor
+        return ts
+
+
+@functools.lru_cache(maxsize=64)
+def _make_regression_eval_step(model: Sequential, loss_fn: Callable):
+    @jax.jit
+    def eval_step(params, state, x, y):
+        pred, _ = model.apply(params, state, x, training=False)
+        return loss_fn(pred, y)
+
+    return eval_step
+
+
+def evaluate_regression(model, params, state, loss_fn, loader) -> float:
+    """Mean loss over a regression loader (reference
+    ``validate_regression_model``, train.hpp:311-380)."""
+    eval_step = _make_regression_eval_step(model, loss_fn)
+    total_loss, total_n = 0.0, 0
+    for x, y in loader:
+        loss = eval_step(params, state, jnp.asarray(x), jnp.asarray(y))
+        total_loss += float(loss) * x.shape[0]
+        total_n += x.shape[0]
+    return total_loss / max(total_n, 1)
+
+
+def train_regression_model(model: Sequential, optimizer: Optimizer,
+                           loss: Callable | str, train_loader, val_loader=None,
+                           config: Optional[TrainingConfig] = None,
+                           scheduler: Optional[Scheduler] = None,
+                           key: Optional[jax.Array] = None) -> Tuple[TrainState, list]:
+    """Regression twin of the classification loop (reference
+    ``train_regression_model``, train.hpp:389-481)."""
+    config = config or TrainingConfig()
+    loss_fn = get_loss(loss) if isinstance(loss, str) else loss
+    key = key if key is not None else jax.random.PRNGKey(config.seed)
+    ts = create_train_state(model, optimizer, key)
+    step = make_train_step(model, loss_fn, optimizer, config.num_microbatches)
+    lr = config.learning_rate
+    history = []
+    sched = scheduler
+    for epoch in range(1, config.epochs + 1):
+        if hasattr(train_loader, "shuffle"):
+            train_loader.shuffle(epoch)
+        total_loss, total_n = 0.0, 0
+        for bi, (x, y) in enumerate(train_loader):
+            ts, loss_v, _ = step(ts, jnp.asarray(x), jnp.asarray(y),
+                                 jax.random.fold_in(key, epoch * 100003 + bi), lr)
+            total_loss += float(loss_v) * x.shape[0]
+            total_n += x.shape[0]
+        train_loss = total_loss / max(total_n, 1)
+        val_loss = (evaluate_regression(model, ts.params, ts.state, loss_fn, val_loader)
+                    if val_loader is not None else None)
+        history.append({"epoch": epoch, "train_loss": train_loss, "val_loss": val_loss,
+                        "lr": lr})
+        msg = f"epoch {epoch}/{config.epochs}: train loss {train_loss:.6f}"
+        if val_loss is not None:
+            msg += f" | val loss {val_loss:.6f}"
+        print(msg, flush=True)
+        if sched is not None:
+            lr = sched.step(val_loss if val_loss is not None else train_loss)
+        elif config.lr_decay_factor != 1.0 and epoch % config.lr_decay_interval == 0:
+            lr *= config.lr_decay_factor
+    return ts, history
+
+
+def train_classification_model(model: Sequential, optimizer: Optimizer,
+                               loss: Callable | str, train_loader,
+                               val_loader=None,
+                               config: Optional[TrainingConfig] = None,
+                               scheduler: Optional[Scheduler] = None,
+                               key: Optional[jax.Array] = None) -> Tuple[TrainState, Trainer]:
+    """Function-style entry matching the reference's
+    ``train_classification_model`` (train.hpp:202)."""
+    config = config or TrainingConfig()
+    trainer = Trainer(model, optimizer, loss, config, scheduler)
+    key = key if key is not None else jax.random.PRNGKey(config.seed)
+    ts = create_train_state(model, optimizer, key)
+    ts = trainer.fit(ts, train_loader, val_loader)
+    return ts, trainer

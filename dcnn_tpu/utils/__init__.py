@@ -1,0 +1,5 @@
+"""Utilities: env config, hardware info, compression (reference ``include/utils/``)."""
+
+from .env import load_env_file, get_env
+
+__all__ = ["load_env_file", "get_env"]

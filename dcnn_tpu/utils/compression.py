@@ -1,0 +1,116 @@
+"""Tensor-payload compression for host-path/DCN transfers.
+
+Reference equivalent: ``ZstdCompressor`` / ``Lz4hcCompressor`` /
+``BloscCompressor`` + meta dispatch
+(``include/pipeline/compression_impl/internal_compressor.hpp:5-15``,
+``meta_compressor.hpp:10-35``) — declared part of the I/O path for pipeline
+messages (``docs/pipeline_architecture.md:8``).
+
+On TPU intra-slice transfers ride ICI and are never compressed; compression
+matters only for host-path/DCN transfers (checkpoint shipping, cross-site
+coordination). Available codecs here: zstd (preferred; same default codec as
+the reference) and zlib (always present). A ``MetaCompressor`` dispatches by
+codec id, wire-compatible layout: ``[1-byte codec id][u64 raw size][payload]``.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, Optional, Protocol
+
+import numpy as np
+
+try:
+    import zstandard as _zstd
+except ImportError:  # pragma: no cover - zstd is present in the image
+    _zstd = None
+
+
+class Compressor(Protocol):
+    codec_id: int
+
+    def compress(self, data: bytes) -> bytes: ...
+    def decompress(self, data: bytes, raw_size: int) -> bytes: ...
+
+
+class ZlibCompressor:
+    codec_id = 1
+
+    def __init__(self, level: int = 6):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        return zlib.decompress(data)
+
+
+class ZstdCompressor:
+    """zstd, the reference's default codec (internal_compressor.hpp:5)."""
+
+    codec_id = 2
+
+    def __init__(self, level: int = 3):
+        if _zstd is None:
+            raise RuntimeError("zstandard not available")
+        self._c = _zstd.ZstdCompressor(level=level)
+        self._d = _zstd.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        return self._d.decompress(data, max_output_size=raw_size or 2**31)
+
+
+class MetaCompressor:
+    """Codec-id-framed dispatch (reference ``meta_compressor.hpp:10-35``)."""
+
+    _HEADER = struct.Struct("<BQ")
+
+    def __init__(self, default: Optional[Compressor] = None):
+        self.codecs: Dict[int, Compressor] = {}
+        zl = ZlibCompressor()
+        self.register(zl)
+        if _zstd is not None:
+            zs = ZstdCompressor()
+            self.register(zs)
+            self.default = default or zs
+        else:
+            self.default = default or zl
+
+    def register(self, codec: Compressor) -> None:
+        self.codecs[codec.codec_id] = codec
+
+    def compress(self, data: bytes, codec: Optional[Compressor] = None) -> bytes:
+        c = codec or self.default
+        return self._HEADER.pack(c.codec_id, len(data)) + c.compress(data)
+
+    def decompress(self, blob: bytes) -> bytes:
+        codec_id, raw_size = self._HEADER.unpack_from(blob)
+        if codec_id not in self.codecs:
+            raise ValueError(f"unknown codec id {codec_id}")
+        return self.codecs[codec_id].decompress(blob[self._HEADER.size:], raw_size)
+
+    # -- tensor helpers (reference BinarySerializer tensor framing,
+    #    binary_serializer.hpp:27-35: rank + dims + raw data) --
+    def compress_array(self, arr: np.ndarray) -> bytes:
+        arr = np.ascontiguousarray(arr)
+        header = struct.pack("<B", arr.ndim) + \
+            b"".join(struct.pack("<Q", d) for d in arr.shape) + \
+            struct.pack("<4s", np.lib.format.dtype_to_descr(arr.dtype).encode()[:4].ljust(4))
+        return self.compress(header + arr.tobytes())
+
+    def decompress_array(self, blob: bytes) -> np.ndarray:
+        raw = self.decompress(blob)
+        ndim = struct.unpack_from("<B", raw)[0]
+        off = 1
+        shape = []
+        for _ in range(ndim):
+            shape.append(struct.unpack_from("<Q", raw, off)[0])
+            off += 8
+        descr = struct.unpack_from("<4s", raw, off)[0].decode().strip("\x00").strip()
+        off += 4
+        return np.frombuffer(raw[off:], dtype=np.dtype(descr)).reshape(shape)

@@ -1,0 +1,94 @@
+"""Hardware introspection.
+
+Reference equivalent: ``HardwareInfo`` (``include/utils/hardware_info.hpp:
+14-300``, 1864-line impl): CPUID features, core topology, cache hierarchy,
+RAM, utilization. On TPU the interesting hardware is the accelerator fleet;
+this module reports JAX device info (platform, chip kind, HBM), host
+CPU/memory from /proc, and live HBM utilization via
+``jax.Device.memory_stats``.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+from typing import Any, Dict, List
+
+
+def _proc_meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo", "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0].endswith(":"):
+                    out[parts[0][:-1]] = int(parts[1])  # kB
+    except OSError:
+        pass
+    return out
+
+
+def get_memory_usage_kb() -> int:
+    """Process RSS in kB (reference ``get_memory_usage_kb``,
+    ``utils/memory.hpp``; printed per epoch, train.hpp:298)."""
+    try:
+        with open("/proc/self/status", "r", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
+class HardwareInfo:
+    @staticmethod
+    def collect() -> Dict[str, Any]:
+        import jax
+
+        devices: List[Dict[str, Any]] = []
+        for d in jax.devices():
+            info: Dict[str, Any] = {
+                "id": d.id, "platform": d.platform,
+                "kind": getattr(d, "device_kind", "unknown"),
+            }
+            try:
+                stats = d.memory_stats()
+                if stats:
+                    info["hbm_bytes_limit"] = stats.get("bytes_limit")
+                    info["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+            except Exception:
+                pass
+            devices.append(info)
+        mem = _proc_meminfo()
+        return {
+            "host": {
+                "machine": platform.machine(),
+                "system": platform.system(),
+                "cpu_count": os.cpu_count(),
+                "ram_total_kb": mem.get("MemTotal", 0),
+                "ram_available_kb": mem.get("MemAvailable", 0),
+                "process_rss_kb": get_memory_usage_kb(),
+            },
+            "devices": devices,
+            "default_backend": jax.default_backend(),
+        }
+
+    @staticmethod
+    def print_info() -> None:
+        """Human-readable dump (reference ``HardwareInfo::print_info``,
+        hardware_info.hpp:244)."""
+        info = HardwareInfo.collect()
+        h = info["host"]
+        print(f"Host: {h['system']}/{h['machine']}, {h['cpu_count']} CPUs, "
+              f"RAM {h['ram_total_kb'] / 1048576:.1f} GiB "
+              f"(avail {h['ram_available_kb'] / 1048576:.1f} GiB), "
+              f"RSS {h['process_rss_kb'] / 1024:.0f} MiB")
+        print(f"Backend: {info['default_backend']}")
+        for d in info["devices"]:
+            line = f"  device {d['platform']}:{d['id']} ({d['kind']})"
+            if d.get("hbm_bytes_limit"):
+                used = (d.get("hbm_bytes_in_use") or 0) / 2**30
+                lim = d["hbm_bytes_limit"] / 2**30
+                line += f" HBM {used:.2f}/{lim:.1f} GiB"
+            print(line)

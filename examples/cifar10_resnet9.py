@@ -1,0 +1,44 @@
+"""CIFAR-10 ResNet-9 trainer (reference ``examples/cifar10_resnet9.cpp``)
+with the reference's augmentation recipe (random crop + hflip + cutout)."""
+
+from common import loader_or_synthetic, setup
+
+from dcnn_tpu.data import AugmentationBuilder, CIFAR10DataLoader
+from dcnn_tpu.models import create_resnet9_cifar10
+from dcnn_tpu.optim import Adam, OneCycleLR
+from dcnn_tpu.train import train_classification_model
+from dcnn_tpu.utils.env import get_env
+
+
+def main():
+    cfg = setup("cifar10_resnet9")
+    aug = (AugmentationBuilder()
+           .random_crop(4)
+           .horizontal_flip(0.5)
+           .cutout(8, 0.5)
+           .build())
+
+    def real():
+        root = get_env("CIFAR10_DIR", "data/cifar-10-batches-bin")
+        train = CIFAR10DataLoader(
+            [f"{root}/data_batch_{i}.bin" for i in range(1, 6)],
+            batch_size=cfg.batch_size, seed=cfg.seed, augmentation=aug)
+        val = CIFAR10DataLoader(f"{root}/test_batch.bin",
+                                batch_size=cfg.batch_size, shuffle=False)
+        train.load_data()
+        val.load_data()
+        return train, val
+
+    train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 10, cfg)
+    model = create_resnet9_cifar10()
+    print(model.summary())
+    steps = cfg.epochs * max(len(train_loader), 1)
+    sched = OneCycleLR(max_lr=cfg.learning_rate, total_steps=cfg.epochs)
+    train_classification_model(model, Adam(cfg.learning_rate, weight_decay=1e-4,
+                                           decouple_weight_decay=True),
+                               "softmax_crossentropy", train_loader, val_loader,
+                               config=cfg, scheduler=sched)
+
+
+if __name__ == "__main__":
+    main()

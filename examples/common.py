@@ -1,0 +1,40 @@
+"""Shared example-trainer plumbing (reference ``examples/*.cpp`` all follow
+load_env → load data → build model → train; SURVEY.md §3.1)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dcnn_tpu.core.config import TrainingConfig
+from dcnn_tpu.data import SyntheticClassificationLoader
+from dcnn_tpu.utils.env import get_env, load_env_file
+from dcnn_tpu.utils.hardware import HardwareInfo
+
+
+def setup(name: str) -> TrainingConfig:
+    load_env_file(os.environ.get("ENV_FILE", "./.env"))
+    cfg = TrainingConfig.load_from_env()
+    print(f"=== {name} ===")
+    HardwareInfo.print_info()
+    print(f"config: {cfg.to_dict()}")
+    return cfg
+
+
+def loader_or_synthetic(make_real, image_shape, num_classes, cfg,
+                        n_train=2048, n_val=512):
+    """Use the real dataset if its path exists, else synthetic data so every
+    trainer runs end-to-end in any environment."""
+    try:
+        return make_real()
+    except (FileNotFoundError, OSError, TypeError) as e:
+        print(f"dataset unavailable ({e}); using synthetic data")
+        train = SyntheticClassificationLoader(
+            n_train, image_shape, num_classes, batch_size=cfg.batch_size,
+            seed=cfg.seed)
+        val = SyntheticClassificationLoader(
+            n_val, image_shape, num_classes, batch_size=cfg.batch_size,
+            seed=cfg.seed + 1)
+        return train, val

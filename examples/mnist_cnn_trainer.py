@@ -1,0 +1,38 @@
+"""MNIST CNN trainer (reference ``examples/mnist_cnn_trainer.cpp``).
+
+Env: MNIST_TRAIN_CSV / MNIST_TEST_CSV point at the CSV files; all
+TrainingConfig vars (EPOCHS, BATCH_SIZE, …) honored. Falls back to synthetic
+data when the dataset is absent.
+"""
+
+from common import loader_or_synthetic, setup
+
+from dcnn_tpu.data import MNISTDataLoader
+from dcnn_tpu.models import create_mnist_trainer
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train import train_classification_model
+from dcnn_tpu.utils.env import get_env
+
+
+def main():
+    cfg = setup("mnist_cnn_trainer")
+
+    def real():
+        train = MNISTDataLoader(get_env("MNIST_TRAIN_CSV", "data/mnist/mnist_train.csv"),
+                                batch_size=cfg.batch_size, seed=cfg.seed)
+        val = MNISTDataLoader(get_env("MNIST_TEST_CSV", "data/mnist/mnist_test.csv"),
+                              batch_size=cfg.batch_size, shuffle=False)
+        train.load_data()
+        val.load_data()
+        return train, val
+
+    train_loader, val_loader = loader_or_synthetic(real, (1, 28, 28), 10, cfg)
+    model = create_mnist_trainer()
+    print(model.summary())
+    train_classification_model(model, Adam(cfg.learning_rate),
+                               "softmax_crossentropy", train_loader, val_loader,
+                               config=cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""UJI indoor-positioning regression trainer (reference
+``examples/uji_ips_trainer.cpp``): MLP over WiFi RSSI features →
+longitude/latitude, Huber loss."""
+
+import numpy as np
+from common import setup
+
+from dcnn_tpu.data import UJIWiFiDataLoader
+from dcnn_tpu.data.loader import ArrayDataLoader
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train.trainer import train_regression_model
+from dcnn_tpu.utils.env import get_env
+
+
+def build_model(num_features: int, num_outputs: int = 2):
+    return (SequentialBuilder("uji_ips_mlp")
+            .input((num_features,))
+            .dense(512).activation("relu").dropout(0.2)
+            .dense(256).activation("relu").dropout(0.2)
+            .dense(128).activation("relu")
+            .dense(num_outputs)
+            .build())
+
+
+def main():
+    cfg = setup("uji_ips_trainer")
+    path = get_env("UJI_CSV", "data/uji/trainingData.csv")
+    try:
+        loader = UJIWiFiDataLoader(path, batch_size=cfg.batch_size, seed=cfg.seed)
+        loader.load_data()
+        x, y = loader._x, loader._y
+    except (FileNotFoundError, OSError):
+        print("dataset unavailable; using synthetic RSSI data")
+        rng = np.random.default_rng(cfg.seed)
+        x = rng.random((2048, 520)).astype(np.float32)
+        w = rng.normal(size=(520, 2)).astype(np.float32)
+        y = (x @ w + rng.normal(scale=0.01, size=(2048, 2))).astype(np.float32)
+        y = (y - y.mean(0)) / (y.std(0) + 1e-8)
+
+    n = len(x)
+    split = int(n * 0.9)
+    train = ArrayDataLoader(x[:split], y[:split], batch_size=cfg.batch_size,
+                            seed=cfg.seed)
+    val = ArrayDataLoader(x[split:], y[split:], batch_size=cfg.batch_size,
+                          shuffle=False, drop_last=False)
+    model = build_model(x.shape[1], y.shape[1])
+    print(model.summary())
+    train_regression_model(model, Adam(cfg.learning_rate), "huber", train, val,
+                           config=cfg)
+
+
+if __name__ == "__main__":
+    main()

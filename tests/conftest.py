@@ -1,0 +1,39 @@
+"""Test config: force the JAX CPU backend with 8 virtual devices.
+
+Mirrors the reference's test strategy of exercising multi-stage machinery
+in-process without real hardware (SURVEY.md §4.7): the pipeline/sharding test
+suites run over an 8-device CPU mesh exactly as they would over a v5e-8.
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The environment's TPU-tunnel plugin registers itself from sitecustomize and
+# force-sets jax_platforms="axon,cpu" (overriding the env var), so the config
+# must be re-overridden here — after the jax import — or every jax.devices()
+# call dials the tunnel instead of creating the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: recompiling every jitted step on a 1-core host
+# dominates test time; the cache makes reruns near-instant.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,197 @@
+"""Data loader + augmentation tests with synthesized dataset files.
+
+Reference analog: ``tiny_imagenet_loader_test.cpp`` (SURVEY.md §4.6).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.data import (
+    ArrayDataLoader, AugmentationBuilder, CIFAR10DataLoader, CIFAR100DataLoader,
+    MNISTDataLoader, SyntheticClassificationLoader, TinyImageNetDataLoader,
+    UJIWiFiDataLoader, one_hot,
+)
+
+
+def test_one_hot():
+    y = one_hot(np.array([0, 2]), 3)
+    np.testing.assert_array_equal(y, [[1, 0, 0], [0, 0, 1]])
+
+
+def test_array_loader_batching_and_shuffle():
+    x = np.arange(10 * 4, dtype=np.float32).reshape(10, 4)
+    y = one_hot(np.arange(10) % 3, 3)
+    loader = ArrayDataLoader(x, y, batch_size=3, shuffle=True, drop_last=True, seed=1)
+    batches = list(loader)
+    assert len(batches) == 3 == len(loader)
+    assert all(b[0].shape == (3, 4) for b in batches)
+    # different epoch → different order; same epoch → same order (determinism)
+    order1 = np.concatenate([b[0][:, 0] for b in loader])
+    loader.shuffle(5)
+    order2 = np.concatenate([b[0][:, 0] for b in loader])
+    assert not np.array_equal(order1, order2)
+    order2b = np.concatenate([b[0][:, 0] for b in loader])
+    np.testing.assert_array_equal(order2, order2b)
+
+
+def test_mnist_csv_loader(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = []
+    labels = [3, 7, 1]
+    for lb in labels:
+        pix = rng.integers(0, 256, size=784)
+        rows.append(",".join([str(lb)] + [str(p) for p in pix]))
+    csv = tmp_path / "mnist.csv"
+    csv.write_text("label," + ",".join(f"p{i}" for i in range(784)) + "\n" +
+                   "\n".join(rows))
+    loader = MNISTDataLoader(str(csv), batch_size=3, shuffle=False)
+    x, y = next(iter(loader))
+    assert x.shape == (3, 1, 28, 28)
+    assert x.max() <= 1.0 and x.min() >= 0.0
+    np.testing.assert_array_equal(np.argmax(y, -1), labels)
+
+
+def test_cifar10_bin_loader(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 7
+    recs = []
+    labels = rng.integers(0, 10, size=n)
+    for lb in labels:
+        recs.append(np.concatenate([[lb], rng.integers(0, 256, size=3072)]).astype(np.uint8))
+    path = tmp_path / "data_batch_1.bin"
+    np.concatenate(recs).tofile(path)
+    loader = CIFAR10DataLoader(str(path), batch_size=7, shuffle=False, drop_last=False)
+    x, y = next(iter(loader))
+    assert x.shape == (7, 3, 32, 32)
+    np.testing.assert_array_equal(np.argmax(y, -1), labels)
+
+
+def test_cifar100_bin_loader_fine_and_coarse(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 5
+    coarse = rng.integers(0, 20, size=n)
+    fine = rng.integers(0, 100, size=n)
+    recs = []
+    for c, f in zip(coarse, fine):
+        recs.append(np.concatenate([[c, f], rng.integers(0, 256, size=3072)]).astype(np.uint8))
+    path = tmp_path / "train.bin"
+    np.concatenate(recs).tofile(path)
+    lf = CIFAR100DataLoader(str(path), label_mode="fine", batch_size=5,
+                            shuffle=False, drop_last=False)
+    _, y = next(iter(lf))
+    np.testing.assert_array_equal(np.argmax(y, -1), fine)
+    lc = CIFAR100DataLoader(str(path), label_mode="coarse", batch_size=5,
+                            shuffle=False, drop_last=False)
+    _, y = next(iter(lc))
+    np.testing.assert_array_equal(np.argmax(y, -1), coarse)
+
+
+def _write_tiny_imagenet(root, wnids=("n001", "n002"), per_class=3):
+    from PIL import Image
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "wnids.txt"), "w") as f:
+        f.write("\n".join(wnids))
+    with open(os.path.join(root, "words.txt"), "w") as f:
+        f.write("\n".join(f"{w}\tname of {w}" for w in wnids))
+    rng = np.random.default_rng(0)
+    for w in wnids:
+        d = os.path.join(root, "train", w, "images")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{w}_{i}.JPEG"))
+    vd = os.path.join(root, "val", "images")
+    os.makedirs(vd, exist_ok=True)
+    lines = []
+    for i, w in enumerate(wnids):
+        arr = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+        fn = f"val_{i}.JPEG"
+        Image.fromarray(arr).save(os.path.join(vd, fn))
+        lines.append(f"{fn}\t{w}\t0\t0\t10\t10")
+    with open(os.path.join(root, "val", "val_annotations.txt"), "w") as f:
+        f.write("\n".join(lines))
+
+
+def test_tiny_imagenet_loader(tmp_path):
+    root = str(tmp_path / "tin")
+    _write_tiny_imagenet(root)
+    train = TinyImageNetDataLoader(root, "train", batch_size=6, shuffle=False,
+                                   drop_last=False, cache=True)
+    x, y = next(iter(train))
+    assert x.shape == (6, 3, 64, 64)
+    assert x.dtype == np.float32 and x.max() <= 1.0
+    assert y.shape == (6, 200)
+    # labels 0..1 used (two wnids)
+    assert set(np.argmax(y, -1)) == {0, 1}
+    # cache file written and reused
+    assert os.path.isfile(train._cache_path())
+    val = TinyImageNetDataLoader(root, "val", batch_size=2, shuffle=False,
+                                 drop_last=False, cache=False)
+    xv, yv = next(iter(val))
+    assert xv.shape == (2, 3, 64, 64)
+
+
+def test_uji_wifi_loader(tmp_path):
+    rows = ["ap1,ap2,ap3,lon,lat"]
+    rng = np.random.default_rng(0)
+    for _ in range(6):
+        rssi = rng.integers(-90, -30, size=3)
+        # include sentinel 100 = not detected
+        rssi[rng.integers(0, 3)] = 100
+        rows.append(",".join(map(str, list(rssi) + [round(rng.uniform(-7700, -7600), 2),
+                                                    round(rng.uniform(4864700, 4864900), 2)])))
+    path = tmp_path / "uji.csv"
+    path.write_text("\n".join(rows))
+    loader = UJIWiFiDataLoader(str(path), batch_size=6, shuffle=False)
+    x, y = next(iter(loader))
+    assert x.shape == (6, 3) and y.shape == (6, 2)
+    assert x.min() >= 0.0 and x.max() <= 1.0   # sentinel remapped then scaled
+    # normalized targets ~ zero-mean
+    np.testing.assert_allclose(y.mean(axis=0), 0.0, atol=1e-3)
+    denorm = loader.denormalize_targets(y)
+    assert abs(denorm[:, 0].mean() - (-7650)) < 60
+
+
+def test_augmentations_shapes_and_effects():
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 3, 16, 16)).astype(np.float32)
+    strategy = (AugmentationBuilder()
+                .brightness(0.5, p=1.0)
+                .contrast(0.5, 1.5, p=1.0)
+                .cutout(4, p=1.0)
+                .gaussian_noise(0.1, p=1.0)
+                .horizontal_flip(p=1.0)
+                .vertical_flip(p=1.0)
+                .random_crop(2, p=1.0)
+                .rotation(10.0, p=1.0)
+                .normalization([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])
+                .build())
+    assert len(strategy.ops) == 9  # all nine reference augmentation families
+    out = strategy(x.copy(), rng)
+    assert out.shape == x.shape
+    assert not np.allclose(out, x)
+
+
+def test_flip_determinism_and_correctness():
+    from dcnn_tpu.data import horizontal_flip
+    x = np.arange(2 * 1 * 2 * 3, dtype=np.float32).reshape(2, 1, 2, 3)
+    flipped = horizontal_flip(p=1.0)(x.copy(), np.random.default_rng(0))
+    np.testing.assert_array_equal(flipped, x[..., ::-1])
+
+
+def test_loader_augmentation_hook_applied():
+    x = np.ones((8, 3, 8, 8), np.float32)
+    y = one_hot(np.zeros(8, np.int64), 2)
+    aug = AugmentationBuilder().brightness(0.5, p=1.0).build()
+    loader = ArrayDataLoader(x, y, batch_size=4, shuffle=False, augmentation=aug)
+    xb, _ = next(iter(loader))
+    assert not np.allclose(xb, 1.0)
+
+
+def test_synthetic_loader_trains():
+    loader = SyntheticClassificationLoader(num_samples=32, image_shape=(1, 8, 8),
+                                           num_classes=4, batch_size=16)
+    x, y = next(iter(loader))
+    assert x.shape == (16, 1, 8, 8) and y.shape == (16, 4)

@@ -1,0 +1,166 @@
+"""Layer-semantics tests.
+
+Reference analog: the per-layer gtest suites (conv2d_layer_test.cpp:23-60
+fixture pattern — analytic output shapes, hand-computed values, gradient
+checks; SURVEY.md §4.3).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.nn import (
+    ActivationLayer, AvgPool2DLayer, BatchNormLayer, Conv2DLayer, DenseLayer,
+    DropoutLayer, FlattenLayer, GroupNormLayer, MaxPool2DLayer, ResidualBlock,
+)
+from dcnn_tpu.nn.layers import LogSoftmaxLayer
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_conv2d_layer_shapes_and_init():
+    layer = Conv2DLayer(8, 3, stride=2, padding=1)
+    assert layer.output_shape((3, 32, 32)) == (8, 16, 16)
+    params, state = layer.init(KEY, (3, 32, 32))
+    assert params["w"].shape == (8, 3, 3, 3)
+    assert params["b"].shape == (8,)
+    assert state == {}
+    # Kaiming-uniform bound = 1/sqrt(fan_in) (conv2d_layer.tpp:71-72)
+    bound = 1.0 / np.sqrt(3 * 3 * 3)
+    w = np.asarray(params["w"])
+    assert w.min() >= -bound and w.max() <= bound
+    assert w.std() > bound / 4  # actually filled, not zeros
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32, 32))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 8, 16, 16)
+
+
+def test_conv2d_channel_mismatch_raises():
+    layer = Conv2DLayer(8, 3, in_channels=4)
+    with pytest.raises(ValueError):
+        layer.init(KEY, (3, 8, 8))
+
+
+def test_dense_layer():
+    layer = DenseLayer(16)
+    params, state = layer.init(KEY, (10,))
+    assert params["w"].shape == (16, 10)
+    x = jnp.ones((4, 10))
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x) @ np.asarray(params["w"]).T + np.asarray(params["b"]),
+        rtol=1e-5)
+    with pytest.raises(ValueError):
+        DenseLayer(4).init(KEY, (3, 8, 8))  # needs flatten first
+
+
+def test_batchnorm_layer_state_threading():
+    layer = BatchNormLayer()
+    params, state = layer.init(KEY, (4, 6, 6))
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 6, 6)) * 3.0 + 1.0
+    y, new_state = layer.apply(params, state, x, training=True)
+    # normalized output: per-channel mean ~0, var ~1
+    m = np.asarray(y).mean(axis=(0, 2, 3))
+    v = np.asarray(y).var(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0.0, atol=1e-5)
+    np.testing.assert_allclose(v, 1.0, atol=1e-3)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(new_state["running_mean"]), 0.0)
+    # eval mode leaves state untouched
+    y2, state2 = layer.apply(params, new_state, x, training=False)
+    np.testing.assert_array_equal(np.asarray(state2["running_mean"]),
+                                  np.asarray(new_state["running_mean"]))
+
+
+def test_groupnorm_layer():
+    layer = GroupNormLayer(num_groups=2)
+    params, state = layer.init(KEY, (4, 5, 5))
+    x = jax.random.normal(jax.random.PRNGKey(3), (3, 4, 5, 5))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == x.shape
+
+
+def test_pool_layers():
+    mp = MaxPool2DLayer(2)  # stride defaults to kernel (reference semantics)
+    assert mp.output_shape((3, 8, 8)) == (3, 4, 4)
+    ap = AvgPool2DLayer(3, stride=2, padding=1)
+    assert ap.output_shape((3, 8, 8)) == (3, 4, 4)
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    y, _ = mp.apply({}, {}, x)
+    np.testing.assert_array_equal(np.asarray(y).reshape(2, 2), [[5, 7], [13, 15]])
+
+
+def test_dropout_layer():
+    layer = DropoutLayer(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = layer.apply({}, {}, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = layer.apply({}, {}, x, training=True, rng=jax.random.PRNGKey(0))
+    arr = np.asarray(y_train)
+    assert set(np.unique(arr)) <= {0.0, 2.0}  # inverted dropout scaling
+    assert abs(arr.mean() - 1.0) < 0.05
+    with pytest.raises(ValueError):
+        layer.apply({}, {}, x, training=True, rng=None)
+
+
+def test_flatten_and_activation():
+    fl = FlattenLayer()
+    assert fl.output_shape((3, 4, 5)) == (60,)
+    x = jax.random.normal(KEY, (2, 3, 4, 5))
+    y, _ = fl.apply({}, {}, x)
+    assert y.shape == (2, 60)
+
+    act = ActivationLayer("leaky_relu", negative_slope=0.1)
+    y, _ = act.apply({}, {}, jnp.asarray([-1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(y), [-0.1, 2.0], rtol=1e-6)
+
+    ls = LogSoftmaxLayer()
+    y, _ = ls.apply({}, {}, jnp.zeros((1, 4)))
+    np.testing.assert_allclose(np.asarray(y), np.log(0.25), rtol=1e-5)
+
+
+def test_residual_block_identity_and_projection():
+    # identity shortcut: same channels, stride 1
+    block = ResidualBlock(
+        layers=[Conv2DLayer(4, 3, 1, 1, name="c0"), BatchNormLayer(name="b0"),
+                ActivationLayer("relu", name="r0"),
+                Conv2DLayer(4, 3, 1, 1, name="c1"), BatchNormLayer(name="b1")],
+        shortcut=[], activation="relu")
+    params, state = block.init(KEY, (4, 8, 8))
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 4, 8, 8))
+    y, new_state = block.apply(params, state, x, training=True)
+    assert y.shape == (2, 4, 8, 8)
+    assert np.asarray(y).min() >= 0.0  # final relu
+
+    # projection shortcut required when shapes change
+    block2 = ResidualBlock(
+        layers=[Conv2DLayer(8, 3, 2, 1, name="c0"), BatchNormLayer(name="b0")],
+        shortcut=[Conv2DLayer(8, 1, 2, 0, use_bias=False, name="p"),
+                  BatchNormLayer(name="pb")])
+    p2, s2 = block2.init(KEY, (4, 8, 8))
+    y2, _ = block2.apply(p2, s2, x)
+    assert y2.shape == (2, 8, 4, 4)
+
+    # mismatched main/shortcut shapes must raise
+    bad = ResidualBlock(layers=[Conv2DLayer(8, 3, 2, 1)], shortcut=[])
+    with pytest.raises(ValueError):
+        bad.init(KEY, (4, 8, 8))
+
+
+def test_residual_block_grad_flows():
+    block = ResidualBlock(
+        layers=[Conv2DLayer(4, 3, 1, 1, name="c0"), BatchNormLayer(name="b0")],
+        shortcut=[])
+    params, state = block.init(KEY, (4, 6, 6))
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 4, 6, 6))
+
+    def loss(p):
+        y, _ = block.apply(p, state, x, training=True)
+        return jnp.sum(y * y)
+
+    grads = jax.grad(loss)(params)
+    gw = np.asarray(grads["main"][0]["w"])
+    assert np.abs(gw).max() > 0.0

@@ -1,0 +1,30 @@
+"""Pallas kernel parity tests (interpret mode on CPU; compiled on TPU).
+
+Reference analog: kernel-vs-naive-reference comparison suites
+(SURVEY.md §4.2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcnn_tpu.ops.pallas import fused_scale_bias_relu
+
+
+def test_fused_scale_bias_relu_matches_jnp(rng):
+    x = jnp.asarray(rng.normal(size=(4, 8, 8, 16)).astype(np.float32))
+    scale = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+    got = fused_scale_bias_relu(x, scale, bias)
+    want = jnp.maximum(x * scale + bias, 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_fused_scale_bias_relu_ragged_rows(rng):
+    # row count not a multiple of the block size exercises grid padding
+    x = jnp.asarray(rng.normal(size=(3, 700)).astype(np.float32))
+    scale = jnp.ones((700,), jnp.float32) * 2.0
+    bias = jnp.zeros((700,), jnp.float32)
+    got = fused_scale_bias_relu(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.maximum(np.asarray(x) * 2.0, 0.0),
+                               rtol=1e-6)

@@ -1,0 +1,165 @@
+"""Pipeline-parallelism tests over the 8-virtual-device CPU mesh.
+
+Reference analog: the in-process coordinator/communicator machinery used as
+the no-network test backend (``in_process_coordinator.hpp:23-60``) and the
+microbatch-ID stress test (``examples/microbatching_test.cpp``)
+(SURVEY.md §4.7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.models import create_mnist_trainer
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.parallel import (
+    FlopBalancedPartitioner, InProcessPipelineCoordinator, NaivePartitioner,
+)
+from dcnn_tpu.parallel.pipeline import split_microbatches
+from dcnn_tpu.train import make_train_step
+from dcnn_tpu.train.trainer import create_train_state
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _model():
+    return (SequentialBuilder("pipe_model")
+            .input((1, 8, 8))
+            .conv2d(4, 3, 1, 1).activation("relu")
+            .conv2d(8, 3, 2, 1).activation("relu")
+            .flatten()
+            .dense(16).activation("relu")
+            .dense(10)
+            .build())
+
+
+def test_naive_partitioner_even_split():
+    model = create_mnist_trainer()
+    parts = NaivePartitioner().get_partitions(model, 3)
+    assert parts[0][0] == 0 and parts[-1][1] == len(model)
+    sizes = [e - s for s, e in parts]
+    assert max(sizes) - min(sizes) <= 1
+    # contiguous, non-overlapping
+    for (s1, e1), (s2, e2) in zip(parts, parts[1:]):
+        assert e1 == s2
+
+
+def test_flop_balanced_partitioner_balances_cost():
+    model = create_mnist_trainer()
+    naive = NaivePartitioner().get_partitions(model, 2)
+    flop = FlopBalancedPartitioner().get_partitions(model, 2)
+    shapes = model.layer_shapes()
+    costs = [l.forward_complexity(s) + l.backward_complexity(s)
+             for l, s in zip(model.layers, shapes)]
+
+    def imbalance(parts):
+        stage_costs = [sum(costs[s:e]) for s, e in parts]
+        return max(stage_costs) / max(min(stage_costs), 1)
+
+    assert flop[0][0] == 0 and flop[-1][1] == len(model)
+    assert imbalance(flop) <= imbalance(naive) + 1e-9
+
+
+def test_split_microbatches():
+    x = jnp.arange(10)
+    mbs = split_microbatches(x, 3)
+    assert [len(m) for m in mbs] == [3, 3, 4]  # remainder in last
+    np.testing.assert_array_equal(np.concatenate([np.asarray(m) for m in mbs]),
+                                  np.arange(10))
+    with pytest.raises(ValueError):
+        split_microbatches(jnp.arange(2), 3)
+
+
+def test_pipeline_forward_matches_single_device():
+    model = _model()
+    coord = InProcessPipelineCoordinator(model, SGD(0.01), "softmax_crossentropy",
+                                         num_stages=3, num_microbatches=2)
+    coord.deploy_stages(KEY)
+    # same init path as a single-device run → identical params
+    params, state = model.init(KEY)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 1, 8, 8))
+    ref, _ = model.apply(params, state, x)
+    out = coord.forward_only(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("schedule", ["sync", "semi_async"])
+def test_pipeline_training_matches_single_device_microbatched(schedule):
+    """Pipeline training with N microbatches must match single-device
+    training with N-way grad accumulation (the reference's correctness
+    criterion for its pipeline: same math, different placement)."""
+    model = _model()
+    nmb = 2
+    coord = InProcessPipelineCoordinator(model, SGD(0.05), "softmax_crossentropy",
+                                         num_stages=2, num_microbatches=nmb)
+    coord.deploy_stages(KEY)
+
+    # single-device reference with identical init and grad accumulation
+    ref_model = _model()
+    opt = SGD(0.05)
+    ts = create_train_state(ref_model, opt, KEY)
+    step = make_train_step(ref_model, lambda p, t: __import__(
+        "dcnn_tpu.ops.losses", fromlist=["softmax_cross_entropy"]
+    ).softmax_cross_entropy(p, t), opt, num_microbatches=nmb, donate=False)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 1, 8, 8)).astype(np.float32)
+    labels = rng.integers(0, 10, size=8)
+    y = np.eye(10, dtype=np.float32)[labels]
+
+    fn = coord.train_batch_sync if schedule == "sync" else coord.train_batch_semi_async
+    for it in range(3):
+        loss_pipe, _ = fn(x, y, lr=0.05)
+        ts, loss_ref, _ = step(ts, jnp.asarray(x), jnp.asarray(y),
+                               jax.random.PRNGKey(9), 0.05)
+        np.testing.assert_allclose(loss_pipe, float(loss_ref), rtol=1e-4, atol=1e-5)
+
+    got_params, _ = coord.gathered_params()
+    flat_got = jax.tree_util.tree_leaves(got_params)
+    flat_ref = jax.tree_util.tree_leaves(ts.params)
+    assert len(flat_got) == len(flat_ref)
+    for a, b in zip(flat_got, flat_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
+
+
+def test_pipeline_stages_on_distinct_devices():
+    """Stages live on distinct devices of the 8-device CPU mesh and still
+    produce a correct chained forward — the multi-chip placement test."""
+    devs = jax.devices()
+    assert len(devs) >= 4, "conftest must provide 8 virtual devices"
+    model = _model()
+    coord = InProcessPipelineCoordinator(
+        model, SGD(0.01), "softmax_crossentropy",
+        num_stages=4, devices=devs[:4], num_microbatches=2)
+    coord.deploy_stages(KEY)
+    for stage, dev in zip(coord.stages, devs[:4]):
+        leaf = jax.tree_util.tree_leaves(stage.params)[0]
+        assert leaf.devices() == {dev}
+    x = np.random.default_rng(0).normal(size=(4, 1, 8, 8)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[[0, 1, 2, 3]]
+    loss, logits = coord.train_batch_semi_async(x, y, 0.01)
+    assert np.isfinite(loss)
+    assert logits.shape == (4, 10)
+    reports = coord.collect_load_reports()
+    assert len(reports) == 4 and reports[0]["forward_count"] > 0
+
+
+def test_microbatch_cache_isolation():
+    """Microbatch-ID stress (reference examples/microbatching_test.cpp):
+    interleaved forwards for many microbatch ids must keep residuals separate
+    and backward must consume the matching cache entry."""
+    model = _model()
+    coord = InProcessPipelineCoordinator(model, SGD(0.01), "softmax_crossentropy",
+                                         num_stages=2, num_microbatches=4)
+    coord.deploy_stages(KEY)
+    stage = coord.stages[0]
+    xs = [jax.random.normal(jax.random.fold_in(KEY, i), (2, 1, 8, 8)) for i in range(4)]
+    outs = [stage.forward(i, xs[i]) for i in range(4)]
+    assert len(stage._cache) == 4
+    g = jnp.ones_like(outs[2])
+    stage.backward(2, g)
+    assert 2 not in stage._cache and len(stage._cache) == 3
+    with pytest.raises(KeyError):
+        stage.backward(2, g)

@@ -1,0 +1,86 @@
+"""Regression tests for review findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import SGD, WarmupCosineAnnealing
+from dcnn_tpu.ops.losses import mse_loss, softmax_cross_entropy
+from dcnn_tpu.parallel import InProcessPipelineCoordinator, make_data_parallel_train_step
+from dcnn_tpu.core.mesh import make_mesh
+from dcnn_tpu.parallel.data_parallel import replicate, shard_batch
+from dcnn_tpu.train import make_train_step
+from dcnn_tpu.train.trainer import create_train_state
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_warmup_cosine_equal_steps_no_crash():
+    s = WarmupCosineAnnealing(0.1, warmup_steps=10, total_steps=10)
+    lrs = [s.step() for _ in range(12)]
+    assert all(np.isfinite(lrs))
+
+
+def test_microbatch_step_handles_indivisible_batch():
+    model = SequentialBuilder("m").input((4,)).dense(3).build()
+    opt = SGD(0.1)
+    ts = create_train_state(model, opt, KEY)
+    step = make_train_step(model, softmax_cross_entropy, opt,
+                           num_microbatches=4, donate=False)
+    # 10 % 4 != 0 → falls back to single microbatch instead of crashing
+    x = jax.random.normal(KEY, (10, 4))
+    y = jax.nn.one_hot(jnp.arange(10) % 3, 3)
+    ts, loss, logits = step(ts, x, y, KEY, 0.1)
+    assert np.isfinite(float(loss)) and logits.shape == (10, 3)
+
+
+def test_data_parallel_2d_input():
+    model = SequentialBuilder("mlp").input((8,)).dense(4).build()
+    opt = SGD(0.1)
+    mesh = make_mesh((8,), ("data",))
+    ts = create_train_state(model, opt, KEY)
+    from dcnn_tpu.train.trainer import TrainState
+    ts = TrainState(replicate(ts.params, mesh), replicate(ts.state, mesh),
+                    replicate(ts.opt_state, mesh), replicate(ts.step, mesh))
+    step = make_data_parallel_train_step(model, mse_loss, opt, mesh)
+    x = jax.random.normal(KEY, (16, 8))
+    y = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+    xs, ys = shard_batch((x, y), mesh)
+    ts, loss, _ = step(ts, xs, ys, KEY, 0.1)
+    assert np.isfinite(float(loss))
+
+
+def test_pipeline_loss_grad_correct_through_log_softmax():
+    """A model ENDING in log-softmax trained with logsoftmax_crossentropy via
+    the pipeline must match single-device autodiff — guards against the
+    double-softmax-jacobian bug (the coordinator must seed backward with the
+    true dL/d(output), not the reference's fused kernel)."""
+    def build():
+        return (SequentialBuilder("ls").input((6,))
+                .dense(8, name="d0").activation("relu")
+                .dense(4, name="d1").log_softmax().build())
+
+    model = build()
+    coord = InProcessPipelineCoordinator(model, SGD(0.1), "logsoftmax_crossentropy",
+                                         num_stages=2, num_microbatches=2)
+    coord.deploy_stages(KEY)
+
+    ref_model = build()
+    opt = SGD(0.1)
+    ts = create_train_state(ref_model, opt, KEY)
+    from dcnn_tpu.ops.losses import log_softmax_cross_entropy
+    step = make_train_step(ref_model, log_softmax_cross_entropy, opt,
+                           num_microbatches=2, donate=False)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=8)]
+    for _ in range(2):
+        loss_p, _ = coord.train_batch_sync(x, y, 0.1)
+        ts, loss_r, _ = step(ts, jnp.asarray(x), jnp.asarray(y), KEY, 0.1)
+        np.testing.assert_allclose(loss_p, float(loss_r), rtol=1e-5)
+    got, _ = coord.gathered_params()
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ts.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
