@@ -32,6 +32,7 @@ class CIFAR10DataLoader(BaseDataLoader):
         self.data_format = data_format
 
     def load_data(self) -> None:
+        from .. import native
         imgs, labels = [], []
         rec = 1 + _IMG_BYTES
         for path in self.files:
@@ -40,10 +41,18 @@ class CIFAR10DataLoader(BaseDataLoader):
             raw = np.fromfile(path, dtype=np.uint8)
             if len(raw) % rec != 0:
                 raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
-            raw = raw.reshape(-1, rec)
-            labels.append(raw[:, 0].astype(np.int64))
-            imgs.append(raw[:, 1:].reshape(-1, 3, 32, 32))
-        x = np.concatenate(imgs).astype(np.float32) / 255.0
+            n = len(raw) // rec
+            decoded = native.decode_label_records(raw, n, 1, 0, _IMG_BYTES)
+            if decoded is not None:
+                x_f, lb = decoded
+                imgs.append(x_f.reshape(-1, 3, 32, 32))
+                labels.append(lb.astype(np.int64))
+            else:
+                rows = raw.reshape(-1, rec)
+                labels.append(rows[:, 0].astype(np.int64))
+                imgs.append(rows[:, 1:].reshape(-1, 3, 32, 32).astype(np.float32)
+                            / 255.0)
+        x = np.concatenate(imgs)
         if self.data_format == "NHWC":
             x = np.transpose(x, (0, 2, 3, 1))
         self._x = np.ascontiguousarray(x)
@@ -68,19 +77,28 @@ class CIFAR100DataLoader(BaseDataLoader):
         return 100 if self.label_mode == "fine" else 20
 
     def load_data(self) -> None:
+        from .. import native
         imgs, labels = [], []
         rec = 2 + _IMG_BYTES
+        col = 1 if self.label_mode == "fine" else 0
         for path in self.files:
             if not os.path.isfile(path):
                 raise FileNotFoundError(path)
             raw = np.fromfile(path, dtype=np.uint8)
             if len(raw) % rec != 0:
                 raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
-            raw = raw.reshape(-1, rec)
-            col = 1 if self.label_mode == "fine" else 0
-            labels.append(raw[:, col].astype(np.int64))
-            imgs.append(raw[:, 2:].reshape(-1, 3, 32, 32))
-        x = np.concatenate(imgs).astype(np.float32) / 255.0
+            n = len(raw) // rec
+            decoded = native.decode_label_records(raw, n, 2, col, _IMG_BYTES)
+            if decoded is not None:
+                x_f, lb = decoded
+                imgs.append(x_f.reshape(-1, 3, 32, 32))
+                labels.append(lb.astype(np.int64))
+            else:
+                rows = raw.reshape(-1, rec)
+                labels.append(rows[:, col].astype(np.int64))
+                imgs.append(rows[:, 2:].reshape(-1, 3, 32, 32).astype(np.float32)
+                            / 255.0)
+        x = np.concatenate(imgs)
         if self.data_format == "NHWC":
             x = np.transpose(x, (0, 2, 3, 1))
         self._x = np.ascontiguousarray(x)

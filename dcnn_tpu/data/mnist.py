@@ -26,11 +26,18 @@ class MNISTDataLoader(BaseDataLoader):
     def load_data(self) -> None:
         if not os.path.isfile(self.csv_path):
             raise FileNotFoundError(self.csv_path)
-        raw = np.loadtxt(self.csv_path, delimiter=",", skiprows=1, dtype=np.float32)
-        if raw.ndim == 1:
-            raw = raw[None]
-        labels = raw[:, 0].astype(np.int64)
-        pixels = raw[:, 1:] / 255.0
+        from .. import native
+        parsed = native.parse_label_csv(self.csv_path, 28 * 28)
+        if parsed is not None:
+            pixels, labels = parsed
+            labels = labels.astype(np.int64)
+        else:
+            raw = np.loadtxt(self.csv_path, delimiter=",", skiprows=1,
+                             dtype=np.float32)
+            if raw.ndim == 1:
+                raw = raw[None]
+            labels = raw[:, 0].astype(np.int64)
+            pixels = raw[:, 1:] / 255.0
         imgs = pixels.reshape(-1, 1, 28, 28)
         if self.data_format == "NHWC":
             imgs = np.transpose(imgs, (0, 2, 3, 1))

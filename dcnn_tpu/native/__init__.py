@@ -1,0 +1,138 @@
+"""Native (C++) host-side kernels with ctypes bindings.
+
+Reference equivalent: the reference's entire data layer is native C++
+(``include/data_loading/``); here native code accelerates the host input
+pipeline that feeds the TPU — CSV parse, label-record decode, u8→f32
+normalize — chunk-parallel over hardware threads (``src/dataio.cpp``).
+
+``lib()`` returns the loaded library, building it with g++ on first use
+(cached as ``libdcnn_native.so`` next to this file). Every consumer must
+fall back to the numpy path when ``available()`` is False — the framework
+never hard-requires the toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "dataio.cpp")
+_SO = os.path.join(_DIR, "libdcnn_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-pthread", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    have_src = os.path.isfile(_SRC)
+    stale = (have_src and os.path.isfile(_SO)
+             and os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+    if not os.path.isfile(_SO) or stale:
+        if not have_src or not _build():
+            _build_failed = True
+            return None
+    try:
+        l = ctypes.CDLL(_SO)
+    except OSError:
+        _build_failed = True
+        return None
+    l.dcnn_u8_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_float]
+    l.dcnn_u8_to_f32.restype = None
+    l.dcnn_decode_label_records.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
+    l.dcnn_decode_label_records.restype = ctypes.c_int
+    l.dcnn_parse_label_csv.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
+    l.dcnn_parse_label_csv.restype = ctypes.c_int64
+    _lib = l
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0) -> np.ndarray:
+    """Normalize a uint8 array to float32 (native if possible)."""
+    src = np.ascontiguousarray(src, np.uint8)
+    l = lib()
+    if l is None:
+        return src.astype(np.float32) * scale
+    dst = np.empty(src.shape, np.float32)
+    l.dcnn_u8_to_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.size, scale)
+    return dst
+
+
+def decode_label_records(raw: np.ndarray, n: int, skip_bytes: int,
+                         label_index: int, img_bytes: int):
+    """Decode n ``[labels…][pixels…]`` records → (images f32 scaled 1/255,
+    labels int32). Returns None if the native library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    raw = np.ascontiguousarray(raw, np.uint8)
+    images = np.empty((n, img_bytes), np.float32)
+    labels = np.empty((n,), np.int32)
+    rc = l.dcnn_decode_label_records(
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), raw.size, n,
+        skip_bytes, label_index, img_bytes,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("record buffer too small for requested decode")
+    return images, labels
+
+
+def parse_label_csv(path: str, pixels_per_row: int, skip_header: bool = True,
+                    scale: float = 1.0 / 255.0):
+    """Parse a ``label,pix…`` CSV → (pixels f32 scaled, labels int32), or
+    None if the native library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    with open(path, "rb") as f:
+        text = f.read()
+    # upper bound on rows: number of newlines + 1
+    max_rows = text.count(b"\n") + 1
+    pixels = np.empty((max_rows, pixels_per_row), np.float32)
+    labels = np.empty((max_rows,), np.int32)
+    rows = l.dcnn_parse_label_csv(
+        text, len(text), pixels_per_row, 1 if skip_header else 0, scale,
+        max_rows,
+        pixels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rows < 0:
+        # The fast parser only accepts integer pixels (the MNIST CSV format);
+        # anything else (float pixels, padded commas) defers to the tolerant
+        # numpy fallback in the caller rather than rejecting the file.
+        return None
+    return pixels[:rows].copy(), labels[:rows].copy()

@@ -27,9 +27,15 @@ from .data_parallel import make_data_parallel_train_step, shard_batch, replicate
 from .pipeline import (
     InProcessPipelineCoordinator, PipelineStage, train_pipeline_batch_sync,
 )
+from .compiled_pipeline import (
+    SequentialStageStack, make_compiled_pipeline_forward,
+    make_compiled_pipeline_train_step, shard_stacked, stack_stage_params,
+)
 
 __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
     "make_data_parallel_train_step", "shard_batch", "replicate",
     "PipelineStage", "InProcessPipelineCoordinator", "train_pipeline_batch_sync",
+    "SequentialStageStack", "make_compiled_pipeline_forward",
+    "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",
 ]

@@ -1,0 +1,110 @@
+"""Multi-host distributed runtime.
+
+Reference equivalent: the coordinator/worker process deployment —
+``DistributedCoordinator`` + ``NetworkStageWorker`` over a hand-rolled asio
+TCP stack with framed binary messages (``tcp_communicator.hpp:113-547``,
+``network_worker.cpp``; SURVEY.md §5.8).
+
+TPU-native mapping: the *data plane* (activations/gradients/parameter
+collectives) rides XLA — ICI within a slice, DCN across slices — inserted by
+GSPMD from sharding annotations; none of the reference's serializer/socket
+machinery has a data-plane analog. What remains host-side is the *control
+plane*: process bootstrap, rank/topology discovery, barriers, and small
+config broadcast. That is ``jax.distributed`` (a gRPC coordination service on
+process 0 — exactly the coordinator/worker shape, minus the bespoke
+protocol) plus the key-value store helpers below, which replace the
+reference's CONFIG_TRANSFER / CONFIG_RECEIVED handshake
+(``coordinator.hpp:456-514``) for shipping stage configs to workers.
+
+Deployment contract (mirrors ``docker-compose.yml`` / ``network_worker``
+CLI): every process runs the same program with COORDINATOR_ADDR /
+NUM_PROCESSES / PROCESS_ID env vars (or TPU-pod auto-detection when all
+three are omitted).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import jax
+
+from ..utils.env import get_env
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the distributed runtime (idempotent).
+
+    Args default from env: COORDINATOR_ADDR ("host:port"), NUM_PROCESSES,
+    PROCESS_ID — the same deployment variables the reference reads
+    (COORDINATOR_HOST/PORT, ``.env.example``). On TPU pods with no explicit
+    args, jax auto-detects from the pod metadata.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or get_env("COORDINATOR_ADDR", "") or None
+    if num_processes is None:
+        n = get_env("NUM_PROCESSES", 0)
+        num_processes = n if n > 0 else None
+    if process_id is None:
+        p = get_env("PROCESS_ID", -1)
+        process_id = p if p >= 0 else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 plays the reference's coordinator role."""
+    return jax.process_index() == 0
+
+
+def _kv_client():
+    client = jax._src.distributed.global_state.client
+    if client is None:
+        raise RuntimeError("multihost.initialize() must be called first")
+    return client
+
+
+def broadcast_config(key: str, config: Dict[str, Any],
+                     timeout_ms: int = 60_000) -> Dict[str, Any]:
+    """Coordinator publishes a JSON config; workers block until it lands.
+
+    Replaces the reference's CONFIG_TRANSFER message + CONFIG_RECEIVED ack
+    (``coordinator.hpp:557-571``): the kv-store get is the ack. Typical use:
+    process 0 publishes each worker's stage model JSON
+    (``Sequential.get_config()``), workers rebuild via the LayerFactory."""
+    client = _kv_client()
+    if is_coordinator():
+        client.key_value_set(key, json.dumps(config))
+        return config
+    blob = client.blocking_key_value_get(key, timeout_ms)
+    return json.loads(blob)
+
+
+def barrier(name: str, timeout_ms: int = 60_000) -> None:
+    """Cross-process barrier (the reference reserved BARRIER_SYNC but never
+    implemented it, ``command_type.hpp:52`` — implemented here)."""
+    _kv_client().wait_at_barrier(name, timeout_ms)

@@ -194,6 +194,39 @@ class Trainer:
             ts, train_loss, train_acc = self.train_epoch(ts, train_loader, epoch_rng, epoch)
             dt = time.perf_counter() - t0
 
+            if self.profiler is not None:
+                # One profiled layer-by-layer fwd/bwd per epoch (device-synced
+                # per layer — a measurement pass outside the jitted fast path,
+                # reference print cadence: print_profiling_summary per run,
+                # sequential.hpp:323-418).
+                self.profiler.maybe_clear_per_batch()
+                for x, y in train_loader:
+                    x = jnp.asarray(x)
+                    for warmup in (True, False):
+                        if warmup:
+                            # snapshot so discarding the compile-heavy warmup
+                            # pass doesn't wipe CUMULATIVE-mode history
+                            snap = (dict(self.profiler.forward_us),
+                                    dict(self.profiler.backward_us),
+                                    dict(self.profiler.counts))
+                        logits, _ = self.profiler.profile_forward(
+                            self.model, ts.params, ts.state, x,
+                            training=True, rng=epoch_rng)
+                        grad = jax.grad(
+                            lambda out: self.loss_fn(out, jnp.asarray(y)))(logits)
+                        self.profiler.profile_backward(
+                            self.model, ts.params, ts.state, x, grad,
+                            rng=epoch_rng)
+                        if warmup:
+                            for store, saved in zip(
+                                    (self.profiler.forward_us,
+                                     self.profiler.backward_us,
+                                     self.profiler.counts), snap):
+                                store.clear()
+                                store.update(saved)
+                    break
+                print(self.profiler.summary(), flush=True)
+
             val_loss = val_acc = None
             if val_loader is not None:
                 val_loss, val_acc = evaluate_classification(

@@ -1,0 +1,101 @@
+"""Compiled (single-jit, shard_map+ppermute) pipeline schedule tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.core.mesh import STAGE_AXIS, make_mesh
+from dcnn_tpu.nn import Conv2DLayer, GroupNormLayer, ResidualBlock
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.parallel.compiled_pipeline import (
+    SequentialStageStack, make_compiled_pipeline_forward,
+    make_compiled_pipeline_train_step, shard_stacked, stack_stage_params,
+)
+
+KEY = jax.random.PRNGKey(0)
+S = 4       # stages
+MB = 6      # microbatches
+
+
+def _mesh():
+    return make_mesh((S,), (STAGE_AXIS,), devices=jax.devices()[:S])
+
+
+def _block():
+    return ResidualBlock(
+        layers=[Conv2DLayer(4, 3, 1, 1, name="c0"),
+                GroupNormLayer(2, name="g0")],
+        shortcut=[], activation="relu")
+
+
+def test_compiled_forward_matches_sequential_chain():
+    mesh = _mesh()
+    stack = SequentialStageStack(_block(), S, (4, 8, 8))
+    params = stack.init(KEY)
+
+    mbs = jax.random.normal(jax.random.PRNGKey(1), (MB, 2, 4, 8, 8))
+    fwd = make_compiled_pipeline_forward(stack.stage_fn, S, MB, mesh)
+    out = fwd(shard_stacked(params, mesh), mbs)
+
+    # reference: run each microbatch through the 4 stages sequentially
+    per_stage = [jax.tree_util.tree_map(lambda x: x[i], params) for i in range(S)]
+    for i in range(MB):
+        h = mbs[i]
+        for sp in per_stage:
+            h = stack.stage_fn(sp, h)
+        np.testing.assert_allclose(np.asarray(out[i]), np.asarray(h),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_compiled_train_step_matches_unpipelined_grads():
+    mesh = _mesh()
+    stack = SequentialStageStack(_block(), S, (4, 8, 8))
+    params = stack.init(KEY)
+    opt = SGD(0.05)
+
+    rng = np.random.default_rng(0)
+    mb_x = jnp.asarray(rng.normal(size=(MB, 2, 4, 8, 8)).astype(np.float32))
+    # fake per-microbatch "labels": flatten conv output to logits via mean —
+    # use an elementwise regression-style loss on the activation itself
+    mb_y = jnp.asarray(rng.normal(size=(MB, 2, 4, 8, 8)).astype(np.float32))
+
+    def loss_fn(pred, tgt):
+        return jnp.mean((pred - tgt) ** 2)
+
+    step = make_compiled_pipeline_train_step(stack.stage_fn, loss_fn, opt, S, MB, mesh)
+    p_sharded = shard_stacked(params, mesh)
+    opt_state = opt.init(p_sharded)
+    new_params, _, loss, outs = step(p_sharded, opt_state, mb_x, mb_y,
+                                     jnp.float32(0.05))
+
+    # unpipelined reference: same math without the schedule
+    def ref_loss(p):
+        per_stage = [jax.tree_util.tree_map(lambda x: x[i], p) for i in range(S)]
+        losses = []
+        for i in range(MB):
+            h = mb_x[i]
+            for sp in per_stage:
+                h = stack.stage_fn(sp, h)
+            losses.append(loss_fn(h, mb_y[i]))
+        return jnp.mean(jnp.stack(losses))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    ref_new = jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, params, ref_g)
+    for a, b in zip(jax.tree_util.tree_leaves(new_params),
+                    jax.tree_util.tree_leaves(ref_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_stage_stack_rejects_shape_changing_block():
+    with pytest.raises(ValueError):
+        SequentialStageStack(Conv2DLayer(8, 3, 2, 1), S, (4, 8, 8))
+
+
+def test_stage_stack_rejects_stateful_block():
+    from dcnn_tpu.nn import BatchNormLayer
+    with pytest.raises(ValueError):
+        SequentialStageStack(BatchNormLayer(), S, (4, 8, 8)).init(KEY)

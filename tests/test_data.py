@@ -53,6 +53,20 @@ def test_mnist_csv_loader(tmp_path):
     np.testing.assert_array_equal(np.argmax(y, -1), labels)
 
 
+def test_mnist_csv_float_pixels_fallback(tmp_path):
+    """CSV with float-formatted pixels must load via the tolerant numpy
+    fallback (the strict native parser declines integer-only input)."""
+    rows = ["label," + ",".join(f"p{i}" for i in range(784))]
+    rows.append(",".join(["7"] + ["0.5"] * 784))
+    csv = tmp_path / "floats.csv"
+    csv.write_text("\n".join(rows))
+    loader = MNISTDataLoader(str(csv), batch_size=1, shuffle=False, drop_last=False)
+    x, y = next(iter(loader))
+    assert x.shape == (1, 1, 28, 28)
+    np.testing.assert_allclose(x, 0.5 / 255.0, rtol=1e-6)
+    assert np.argmax(y) == 7
+
+
 def test_cifar10_bin_loader(tmp_path):
     rng = np.random.default_rng(0)
     n = 7

@@ -1,0 +1,56 @@
+"""Multi-host control-plane tests.
+
+Runs real multi-process jax.distributed coordination in subprocesses (CPU
+backend) — the analog of the reference exercising coordinator/worker over
+docker-compose on one machine (SURVEY.md §4.7).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcnn_tpu.parallel import multihost
+
+    pid = int(sys.argv[1])
+    multihost.initialize("127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert multihost.process_count() == 2
+    assert multihost.is_coordinator() == (pid == 0)
+
+    # coordinator ships a stage config; worker receives it (CONFIG_TRANSFER)
+    cfg = multihost.broadcast_config(
+        "stage_cfg", {{"layers": [{{"type": "flatten", "name": "f"}}], "pid": 0}})
+    assert cfg["layers"][0]["type"] == "flatten", cfg
+
+    multihost.barrier("ready")
+    print(f"proc {{pid}} OK", flush=True)
+    multihost.shutdown()
+""")
+
+
+@pytest.mark.slow
+def test_two_process_config_broadcast_and_barrier(tmp_path):
+    port = 23456
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO, port=port))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, str(script), str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                              env=env, text=True)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"proc {i} OK" in out

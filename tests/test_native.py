@@ -1,0 +1,108 @@
+"""Native C++ data-kernel tests: build via g++, compare against numpy.
+
+Reference analog: the reference's data layer is native C++; these tests hold
+the ctypes bindings to the same numbers the pure-numpy fallback produces.
+"""
+
+import numpy as np
+import pytest
+
+from dcnn_tpu import native
+
+
+requires_native = pytest.mark.skipif(not native.available(),
+                                     reason="g++ toolchain unavailable")
+
+
+@requires_native
+def test_u8_to_f32_matches_numpy(rng):
+    src = rng.integers(0, 256, size=(3, 17, 5), dtype=np.uint8)
+    got = native.u8_to_f32(src)
+    np.testing.assert_allclose(got, src.astype(np.float32) / 255.0, rtol=1e-7)
+    assert got.dtype == np.float32 and got.shape == src.shape
+
+
+@requires_native
+def test_decode_label_records_cifar10_layout(rng):
+    n, img = 9, 3 * 32 * 32
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    recs = []
+    for lb in labels:
+        recs.append(np.concatenate([[lb], rng.integers(0, 256, size=img,
+                                                       dtype=np.uint8)]))
+    raw = np.concatenate(recs).astype(np.uint8)
+    images, got_labels = native.decode_label_records(raw, n, 1, 0, img)
+    np.testing.assert_array_equal(got_labels, labels)
+    ref = raw.reshape(n, 1 + img)[:, 1:].astype(np.float32) / 255.0
+    np.testing.assert_allclose(images, ref, rtol=1e-7)
+
+
+@requires_native
+def test_decode_label_records_cifar100_fine(rng):
+    n, img = 4, 3 * 32 * 32
+    coarse = rng.integers(0, 20, size=n, dtype=np.uint8)
+    fine = rng.integers(0, 100, size=n, dtype=np.uint8)
+    recs = []
+    for c, f in zip(coarse, fine):
+        recs.append(np.concatenate([[c, f], rng.integers(0, 256, size=img,
+                                                         dtype=np.uint8)]))
+    raw = np.concatenate(recs).astype(np.uint8)
+    _, got = native.decode_label_records(raw, n, 2, 1, img)
+    np.testing.assert_array_equal(got, fine)
+
+
+@requires_native
+def test_decode_short_buffer_raises(rng):
+    with pytest.raises(ValueError):
+        native.decode_label_records(np.zeros(10, np.uint8), 4, 1, 0, 3072)
+
+
+@requires_native
+def test_parse_label_csv_matches_numpy(tmp_path, rng):
+    n, px = 6, 784
+    labels = rng.integers(0, 10, size=n)
+    pixels = rng.integers(0, 256, size=(n, px))
+    lines = ["label," + ",".join(f"p{i}" for i in range(px))]
+    for lb, row in zip(labels, pixels):
+        lines.append(",".join([str(lb)] + [str(v) for v in row]))
+    path = tmp_path / "mnist.csv"
+    path.write_text("\n".join(lines) + "\n")
+    got_px, got_lb = native.parse_label_csv(str(path), px)
+    np.testing.assert_array_equal(got_lb, labels)
+    np.testing.assert_allclose(got_px, pixels.astype(np.float32) / 255.0,
+                               rtol=1e-7)
+
+
+@requires_native
+def test_parse_label_csv_unparseable_defers_to_fallback(tmp_path):
+    # missing a pixel column / float pixels → the strict fast parser declines
+    # (returns None) so callers run the tolerant numpy path instead
+    path = tmp_path / "bad.csv"
+    path.write_text("label,p0,p1\n3,12\n")
+    assert native.parse_label_csv(str(path), 2) is None
+    path2 = tmp_path / "floats.csv"
+    path2.write_text("label,p0,p1\n3,0.5,1.0\n")
+    assert native.parse_label_csv(str(path2), 2) is None
+
+
+@requires_native
+def test_loaders_use_native_and_match_fallback(tmp_path, rng, monkeypatch):
+    """MNIST/CIFAR loaders must produce identical tensors through the native
+    and numpy paths."""
+    from dcnn_tpu.data import CIFAR10DataLoader, MNISTDataLoader
+
+    # CIFAR
+    n = 5
+    recs = [np.concatenate([[rng.integers(0, 10)],
+                            rng.integers(0, 256, size=3072)]).astype(np.uint8)
+            for _ in range(n)]
+    binpath = tmp_path / "batch.bin"
+    np.concatenate(recs).tofile(binpath)
+
+    l1 = CIFAR10DataLoader(str(binpath), batch_size=n, shuffle=False, drop_last=False)
+    l1.load_data()
+    monkeypatch.setattr(native, "decode_label_records", lambda *a, **k: None)
+    l2 = CIFAR10DataLoader(str(binpath), batch_size=n, shuffle=False, drop_last=False)
+    l2.load_data()
+    np.testing.assert_allclose(l1._x, l2._x, rtol=1e-7)
+    np.testing.assert_array_equal(l1._y, l2._y)
