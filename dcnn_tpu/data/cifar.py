@@ -23,6 +23,27 @@ CIFAR10_CLASS_NAMES = ["airplane", "automobile", "bird", "cat", "deer",
                        "dog", "frog", "horse", "ship", "truck"]
 
 
+def _decode_file(path: str, skip_bytes: int, label_col: int):
+    """Decode one CIFAR binary file → (images NCHW f32/255, labels int64),
+    native fast path with numpy fallback."""
+    from .. import native
+    rec = skip_bytes + _IMG_BYTES
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    raw = np.fromfile(path, dtype=np.uint8)
+    if len(raw) % rec != 0:
+        raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
+    n = len(raw) // rec
+    decoded = native.decode_label_records(raw, n, skip_bytes, label_col,
+                                          _IMG_BYTES)
+    if decoded is not None:
+        x_f, lb = decoded
+        return x_f.reshape(-1, 3, 32, 32), lb.astype(np.int64)
+    rows = raw.reshape(-1, rec)
+    return (rows[:, skip_bytes:].reshape(-1, 3, 32, 32).astype(np.float32)
+            / 255.0), rows[:, label_col].astype(np.int64)
+
+
 class CIFAR10DataLoader(BaseDataLoader):
     NUM_CLASSES = 10
 
@@ -32,26 +53,11 @@ class CIFAR10DataLoader(BaseDataLoader):
         self.data_format = data_format
 
     def load_data(self) -> None:
-        from .. import native
         imgs, labels = [], []
-        rec = 1 + _IMG_BYTES
         for path in self.files:
-            if not os.path.isfile(path):
-                raise FileNotFoundError(path)
-            raw = np.fromfile(path, dtype=np.uint8)
-            if len(raw) % rec != 0:
-                raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
-            n = len(raw) // rec
-            decoded = native.decode_label_records(raw, n, 1, 0, _IMG_BYTES)
-            if decoded is not None:
-                x_f, lb = decoded
-                imgs.append(x_f.reshape(-1, 3, 32, 32))
-                labels.append(lb.astype(np.int64))
-            else:
-                rows = raw.reshape(-1, rec)
-                labels.append(rows[:, 0].astype(np.int64))
-                imgs.append(rows[:, 1:].reshape(-1, 3, 32, 32).astype(np.float32)
-                            / 255.0)
+            x_f, lb = _decode_file(path, skip_bytes=1, label_col=0)
+            imgs.append(x_f)
+            labels.append(lb)
         x = np.concatenate(imgs)
         if self.data_format == "NHWC":
             x = np.transpose(x, (0, 2, 3, 1))
@@ -77,27 +83,12 @@ class CIFAR100DataLoader(BaseDataLoader):
         return 100 if self.label_mode == "fine" else 20
 
     def load_data(self) -> None:
-        from .. import native
         imgs, labels = [], []
-        rec = 2 + _IMG_BYTES
         col = 1 if self.label_mode == "fine" else 0
         for path in self.files:
-            if not os.path.isfile(path):
-                raise FileNotFoundError(path)
-            raw = np.fromfile(path, dtype=np.uint8)
-            if len(raw) % rec != 0:
-                raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
-            n = len(raw) // rec
-            decoded = native.decode_label_records(raw, n, 2, col, _IMG_BYTES)
-            if decoded is not None:
-                x_f, lb = decoded
-                imgs.append(x_f.reshape(-1, 3, 32, 32))
-                labels.append(lb.astype(np.int64))
-            else:
-                rows = raw.reshape(-1, rec)
-                labels.append(rows[:, col].astype(np.int64))
-                imgs.append(rows[:, 2:].reshape(-1, 3, 32, 32).astype(np.float32)
-                            / 255.0)
+            x_f, lb = _decode_file(path, skip_bytes=2, label_col=col)
+            imgs.append(x_f)
+            labels.append(lb)
         x = np.concatenate(imgs)
         if self.data_format == "NHWC":
             x = np.transpose(x, (0, 2, 3, 1))

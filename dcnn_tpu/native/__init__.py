@@ -29,12 +29,21 @@ _build_failed = False
 
 
 def _build() -> bool:
+    # Compile to a process-unique temp path and rename into place: rename is
+    # atomic, so concurrent first-use builds (multihost spawns N identical
+    # processes) can never CDLL a partially written .so.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-pthread", _SRC, "-o", _SO]
+           "-pthread", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -145,6 +145,11 @@ std::int64_t dcnn_parse_label_csv(const char *text, std::int64_t len,
       if (!digit) { ok.store(false); return; }
       dst[j] = static_cast<float>(v) * scale;
     }
+    // The row must be fully consumed: extra columns mean the file does not
+    // match the expected pixels_per_row layout — reject rather than silently
+    // training on misaligned pixels.
+    if (p < end && *p == '\r') ++p;
+    if (p < end && *p != '\n') { ok.store(false); return; }
   });
   return ok.load() ? rows : -1;
 }

@@ -120,7 +120,19 @@ def make_compiled_pipeline_forward(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(smapped)
+    jitted = jax.jit(smapped)
+
+    def forward(stacked_params, mbs):
+        # The schedule length is baked in at build time; jax dynamic indexing
+        # clamps out-of-range microbatch indices, so a mismatched leading dim
+        # would silently re-feed/overwrite microbatches instead of erroring.
+        if mbs.shape[0] != num_microbatches:
+            raise ValueError(
+                f"microbatches leading dim {mbs.shape[0]} != "
+                f"num_microbatches {num_microbatches} this pipeline was built for")
+        return jitted(stacked_params, mbs)
+
+    return forward
 
 
 def make_compiled_pipeline_train_step(

@@ -82,7 +82,15 @@ def is_coordinator() -> bool:
 
 
 def _kv_client():
-    client = jax._src.distributed.global_state.client
+    # jax.distributed exposes no public kv-store handle; the private path is
+    # isolated here so a jax upgrade that moves it fails with one clear error.
+    try:
+        client = jax._src.distributed.global_state.client
+    except AttributeError as e:
+        raise RuntimeError(
+            "this jax version moved the distributed kv-store client "
+            "(jax._src.distributed.global_state); update multihost._kv_client"
+        ) from e
     if client is None:
         raise RuntimeError("multihost.initialize() must be called first")
     return client

@@ -6,6 +6,7 @@ docker-compose on one machine (SURVEY.md §4.7).
 """
 
 import os
+import socket
 import subprocess
 import sys
 import textwrap
@@ -39,7 +40,10 @@ WORKER = textwrap.dedent("""
 
 @pytest.mark.slow
 def test_two_process_config_broadcast_and_barrier(tmp_path):
-    port = 23456
+    # ephemeral port: a fixed one collides under parallel/concurrent test runs
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO, port=port))
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -83,6 +83,11 @@ def test_parse_label_csv_unparseable_defers_to_fallback(tmp_path):
     path2 = tmp_path / "floats.csv"
     path2.write_text("label,p0,p1\n3,0.5,1.0\n")
     assert native.parse_label_csv(str(path2), 2) is None
+    # extra columns (row longer than pixels_per_row) must decline too, not
+    # silently truncate to the first pixels_per_row values
+    path3 = tmp_path / "extra.csv"
+    path3.write_text("label,p0,p1\n3,10,20,30\n")
+    assert native.parse_label_csv(str(path3), 2) is None
 
 
 @requires_native
