@@ -10,6 +10,8 @@ framework:
 - ``"stage"`` — pipeline-stage axis (the analog of the reference's worker
   chain); activations move with ``ppermute``.
 - ``"model"`` — reserved for tensor parallelism of wide layers.
+- ``"seq"``   — sequence/context parallelism; ring attention rotates K/V
+  shards over this axis with ``ppermute`` (``dcnn_tpu/parallel/sequence.py``).
 """
 
 from __future__ import annotations
@@ -24,10 +26,11 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 STAGE_AXIS = "stage"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
-def mesh_axes() -> Tuple[str, str, str]:
-    return (DATA_AXIS, STAGE_AXIS, MODEL_AXIS)
+def mesh_axes() -> Tuple[str, ...]:
+    return (DATA_AXIS, STAGE_AXIS, MODEL_AXIS, SEQ_AXIS)
 
 
 def make_mesh(

@@ -18,6 +18,7 @@ from .layers import (
     ActivationLayer, AvgPool2DLayer, BatchNormLayer, Conv2DLayer, DenseLayer,
     DropoutLayer, FlattenLayer, GroupNormLayer, MaxPool2DLayer,
 )
+from .attention_layer import MultiHeadAttentionLayer
 from .residual import ResidualBlock
 from .sequential import Sequential
 from .factory import LayerFactory, register_layer, layer_from_config
@@ -27,7 +28,7 @@ __all__ = [
     "Layer", "ParameterizedLayer", "StatelessLayer",
     "Conv2DLayer", "DenseLayer", "BatchNormLayer", "GroupNormLayer",
     "MaxPool2DLayer", "AvgPool2DLayer", "DropoutLayer", "FlattenLayer",
-    "ActivationLayer", "ResidualBlock",
+    "ActivationLayer", "ResidualBlock", "MultiHeadAttentionLayer",
     "Sequential", "SequentialBuilder",
     "LayerFactory", "register_layer", "layer_from_config",
 ]

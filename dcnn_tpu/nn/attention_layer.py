@@ -1,0 +1,110 @@
+"""Multi-head self-attention layer.
+
+No reference analog (the reference is CNN-only, SURVEY.md §5.7); provided so
+attention/long-context models are first-class citizens of the same
+``Sequential``/factory/pipeline machinery as the CNN layers. Per-sample
+shape convention: ``(S, E)`` — sequence length × embed dim (batched apply
+sees ``(B, S, E)``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.precision import get_precision
+from ..ops.attention import attention, blockwise_attention, flash_attention
+from . import initializers as init
+from .factory import register_layer
+from .layer import ParameterizedLayer
+
+
+@register_layer("multi_head_attention")
+class MultiHeadAttentionLayer(ParameterizedLayer):
+    """Self-attention: qkv projections → scaled-dot-product → out projection.
+
+    ``impl``: ``"flash"`` (Pallas kernel, default), ``"blockwise"``
+    (lax.scan online softmax), or ``"naive"`` (materialised scores — the
+    numerics oracle). All exact; choice affects memory/speed only.
+    """
+
+    def __init__(self, num_heads: int, embed_dim: Optional[int] = None,
+                 causal: bool = False, impl: str = "flash",
+                 use_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        if impl not in ("flash", "blockwise", "naive"):
+            raise ValueError(f"unknown attention impl {impl!r}")
+        self.num_heads = int(num_heads)
+        self.embed_dim = embed_dim
+        self.causal = bool(causal)
+        self.impl = impl
+        self.use_bias = bool(use_bias)
+
+    def init(self, key, input_shape):
+        if len(input_shape) != 2:
+            raise ValueError(f"{self.name}: attention expects (S, E) input, "
+                             f"got {input_shape}")
+        e = input_shape[1]
+        if self.embed_dim is not None and self.embed_dim != e:
+            raise ValueError(f"{self.name}: expected embed dim "
+                             f"{self.embed_dim}, got {e}")
+        self.embed_dim = e
+        if e % self.num_heads:
+            raise ValueError(f"{self.name}: embed dim {e} not divisible by "
+                             f"{self.num_heads} heads")
+        keys = jax.random.split(key, 8)
+        def lin(i, shape, fan_in):
+            return init.kaiming_uniform(keys[i], shape, fan_in)
+        params = {
+            "wq": lin(0, (e, e), e), "wk": lin(1, (e, e), e),
+            "wv": lin(2, (e, e), e), "wo": lin(3, (e, e), e),
+        }
+        if self.use_bias:
+            params.update({
+                "bq": lin(4, (e,), e), "bk": lin(5, (e,), e),
+                "bv": lin(6, (e,), e), "bo": lin(7, (e,), e),
+            })
+        return params, {}
+
+    def _project(self, x, w, b):
+        y = jnp.matmul(x, w, precision=get_precision())
+        return y + b if b is not None else y
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        b_, s, e = x.shape
+        h, dh = self.num_heads, e // self.num_heads
+        get = params.get
+        q = self._project(x, params["wq"], get("bq"))
+        k = self._project(x, params["wk"], get("bk"))
+        v = self._project(x, params["wv"], get("bv"))
+        # (B, S, E) -> (B, H, S, Dh)
+        def heads(t):
+            return t.reshape(b_, s, h, dh).transpose(0, 2, 1, 3)
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.impl == "naive":
+            o = attention(q, k, v, causal=self.causal)
+        elif self.impl == "blockwise":
+            o = blockwise_attention(q, k, v, causal=self.causal)
+        else:
+            o = flash_attention(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b_, s, e)
+        return self._project(o, params["wo"], get("bo")), state
+
+    def output_shape(self, input_shape):
+        return input_shape
+
+    def forward_complexity(self, input_shape):
+        s, e = input_shape
+        return 4 * 2 * s * e * e + 2 * 2 * s * s * e  # projections + scores·v
+
+    def param_count(self, input_shape):
+        e = input_shape[1]
+        return 4 * e * e + (4 * e if self.use_bias else 0)
+
+    def get_config(self):
+        return {"type": self.type_name, "name": self.name,
+                "num_heads": self.num_heads, "embed_dim": self.embed_dim,
+                "causal": self.causal, "impl": self.impl,
+                "use_bias": self.use_bias}

@@ -24,6 +24,7 @@ from .losses import (
     mse_loss, mae_loss, huber_loss, LOSSES,
 )
 from .metrics import accuracy, correct_count
+from .attention import attention, blockwise_attention, flash_attention
 
 __all__ = [
     "elementwise",
@@ -35,4 +36,5 @@ __all__ = [
     "cross_entropy", "softmax_cross_entropy", "log_softmax_cross_entropy",
     "mse_loss", "mae_loss", "huber_loss", "LOSSES",
     "accuracy", "correct_count",
+    "attention", "blockwise_attention", "flash_attention",
 ]

@@ -1,0 +1,260 @@
+"""Scaled-dot-product attention ops: naive, blockwise (flash-style), Pallas.
+
+No reference analog — the reference is a CNN-only framework with no attention
+anywhere (SURVEY.md §5.7 verified absence). Attention is nonetheless
+first-class here because it is the op whose memory behaviour defines
+long-context scaling on TPU: the blockwise/online-softmax formulation keeps
+the S×S score matrix out of HBM, and is also the local compute step of ring
+attention (``dcnn_tpu/parallel/sequence.py``).
+
+Shapes follow (B, H, S, D): batch, heads, sequence, head dim. All functions
+are jittable with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.precision import get_precision
+
+NEG_INF = -1e30
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = False, mask: Optional[jax.Array] = None,
+              scale: Optional[float] = None) -> jax.Array:
+    """Reference (materialising) attention: ``softmax(q·kᵀ·scale)·v``.
+
+    ``mask``: broadcastable to (B, H, Sq, Sk); True = attend. O(S²) memory —
+    the numerics oracle for the blockwise/pallas/ring variants.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision=get_precision()) * scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal_mask, scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v,
+                      precision=get_precision())
+
+
+def _online_block(acc, m, l, q, k_blk, v_blk, scale, score_mask):
+    """One online-softmax accumulation step for query block against one
+    K/V block. Returns updated (acc, m, l). score_mask: (Sq, Skb) or None."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k_blk,
+                   precision=get_precision()) * scale
+    if score_mask is not None:
+        s = jnp.where(score_mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    p = jnp.exp(s - m_new[..., None])
+    if score_mask is not None:
+        p = jnp.where(score_mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v_blk.dtype), v_blk,
+        precision=get_precision())
+    return acc_new, m_new, l_new
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_kv", "scale"))
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = False, block_kv: int = 512,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Flash-style attention: online softmax over K/V blocks via ``lax.scan``
+    — never materialises the (Sq, Sk) score matrix. Exact (not approximate);
+    matches :func:`attention` to float tolerance.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_kv = min(block_kv, sk)
+    nblk = -(-sk // block_kv)
+    pad = nblk * block_kv - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, nblk, block_kv, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, block_kv, d).transpose(2, 0, 1, 3, 4)
+
+    q_pos = jnp.arange(sq)                       # global query positions
+    diag_offset = sk - sq                        # causal diag when Sq != Sk
+
+    def body(carry, blk):
+        acc, m, l = carry
+        k_blk, v_blk, blk_idx = blk
+        kv_pos = blk_idx * block_kv + jnp.arange(block_kv)
+        valid = kv_pos < sk                      # padding mask
+        if causal:
+            allowed = kv_pos[None, :] <= (q_pos[:, None] + diag_offset)
+            score_mask = allowed & valid[None, :]
+        else:
+            score_mask = jnp.broadcast_to(valid[None, :], (sq, block_kv))
+        acc, m, l = _online_block(acc, m, l, q, k_blk, v_blk, scale,
+                                  score_mask[None, None])
+        return (acc, m, l), None
+
+    acc0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, sq), NEG_INF, q.dtype)
+    l0 = jnp.zeros((b, h, sq), q.dtype)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0), (kb, vb, jnp.arange(nblk)))
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  nkv: int, sk: int, sq: int, causal: bool, scale: float,
+                  precision):
+    """One (batch·head, q-block, kv-block) program. K/V are *streamed*: each
+    program sees one (block_kv, d) tile (grid's innermost axis walks the kv
+    blocks), so VMEM holds one K and one V tile — never the whole sequence.
+    Online-softmax running state (acc, m, l) lives in VMEM scratch carried
+    across the kv axis; the output block is written on the last kv step.
+    Refs carry a leading size-1 batch·head block dim."""
+    t = pl.program_id(2)
+    q = q_ref[0]
+    block_q, d = q.shape
+    block_kv = k_ref.shape[1]
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = pl.program_id(1) * block_q
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    kv_pos = t * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+
+    k_blk, v_blk = k_ref[0], v_ref[0]
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            precision=precision,
+                            preferred_element_type=jnp.float32) * scale
+    mask = kv_pos < sk
+    if causal:
+        mask &= kv_pos <= (q_pos + (sk - sq))
+    s = jnp.where(mask, s, NEG_INF)
+    m = m_ref[:, 0]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    corr = jnp.exp(m - m_new)
+    l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+    m_ref[:, 0] = m_new
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(t == nkv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas is TPU/interpret-only in some builds; degrade gracefully
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_kv, scale, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, sk)
+    pad_q = -sq % block_q
+    pad_kv = -sk % block_kv
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_kv), (0, 0))) if pad_kv else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_kv), (0, 0))) if pad_kv else v
+    sq_p, sk_p = sq + pad_q, sk + pad_kv
+    nkv = sk_p // block_kv
+    qf = qp.reshape(b * h, sq_p, d)
+    kf = kp.reshape(b * h, sk_p, d)
+    vf = vp.reshape(b * h, sk_p, d)
+    kernel = functools.partial(_flash_kernel, nkv=nkv, sk=sk, sq=sq,
+                               causal=causal, scale=scale,
+                               precision=get_precision())
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        # kv axis innermost: TPU grids run sequentially with the last axis
+        # fastest, so scratch accumulators carry across kv steps per q block
+        grid=(b * h, sq_p // block_q, nkv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq_p, d)[:, :, :sq]
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, block_q, block_kv, scale, interpret):
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_kv=block_kv, scale=scale, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
+    out = _flash_attention(q, k, v, causal, block_q, block_kv, scale, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_kv, scale, interpret, res, g):
+    # Backward recomputes through the blockwise formulation (same memory
+    # profile as a hand-written flash backward; XLA fuses the recompute).
+    q, k, v = res
+    def f(q, k, v):
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_kv=block_kv, scale=scale)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, block_q: int = 256,
+                    block_kv: int = 256, scale: Optional[float] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas flash-attention forward (online softmax, scores stay in VMEM),
+    differentiable via recompute-based VJP. Falls back to
+    :func:`blockwise_attention` when Pallas is unavailable. Off-TPU the
+    kernel runs in interpret mode (slow — tests only).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if not _HAVE_PALLAS:
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_kv=block_kv, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, causal, block_q, block_kv, float(scale),
+                            interpret)

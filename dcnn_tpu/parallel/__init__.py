@@ -31,6 +31,9 @@ from .compiled_pipeline import (
     SequentialStageStack, make_compiled_pipeline_forward,
     make_compiled_pipeline_train_step, shard_stacked, stack_stage_params,
 )
+from .sequence import (
+    SEQ_AXIS, make_ring_attention, make_ulysses_attention, shard_sequence,
+)
 
 __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
@@ -38,4 +41,6 @@ __all__ = [
     "PipelineStage", "InProcessPipelineCoordinator", "train_pipeline_batch_sync",
     "SequentialStageStack", "make_compiled_pipeline_forward",
     "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",
+    "SEQ_AXIS", "make_ring_attention", "make_ulysses_attention",
+    "shard_sequence",
 ]

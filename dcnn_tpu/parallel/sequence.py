@@ -1,0 +1,151 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+No reference analog — the reference has no attention or sequence axis
+(SURVEY.md §5.7); its only long-input scaling axes are microbatching and
+pipeline stages. For the TPU framework, long-context is first-class: the
+sequence dim is sharded over a mesh axis and attention runs without ever
+gathering the full sequence on one chip.
+
+Two standard strategies, both exact:
+
+- **Ring attention** (:func:`ring_attention`): each device keeps its local
+  Q shard and rotates K/V shards around the ring with ``ppermute`` (ICI
+  neighbour hops), accumulating online-softmax partials — compute overlaps
+  the rotation, memory per chip is O(S/n). Causality is enforced per
+  (q-shard, kv-shard) pair from global offsets.
+- **Ulysses** (:func:`ulysses_attention`): ``all_to_all`` swaps the sharded
+  axis from sequence to heads, runs dense local attention on full sequences
+  for H/n heads, and swaps back. Cheaper collectives for moderate S; requires
+  heads % n == 0.
+
+Both run under ``shard_map`` over the ``"seq"`` mesh axis and compose with the
+``"data"`` axis (batch sharding) of the same mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.mesh import SEQ_AXIS
+from ..ops.attention import NEG_INF, _online_block
+
+
+def shard_sequence(tree, mesh: Mesh, axis: str = SEQ_AXIS, seq_dim: int = 2):
+    """Place (B, H, S, D) arrays with S sharded over ``axis``."""
+    def put(x):
+        spec = [None] * x.ndim
+        spec[seq_dim] = axis
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree_util.tree_map(put, tree)
+
+
+def _ring_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+    """Per-device body: local q (B,H,Sq/n,D) attends to every kv shard as it
+    rotates by. ppermute sends each block from device d to d+1, so after t
+    rounds device i holds the block originally owned by (i - t) mod n; the
+    causal mask for each round derives from that owner's global offset."""
+    idx = jax.lax.axis_index(axis)
+    sq = q.shape[2]
+    b, h = q.shape[0], q.shape[1]
+
+    acc = jnp.zeros_like(q)
+    m = jnp.full((b, h, sq), NEG_INF, q.dtype)
+    l = jnp.zeros((b, h, sq), q.dtype)
+
+    # ppermute perm: device d sends its kv block to d+1, so after t rounds
+    # device i holds the block originally owned by (i - t) mod n.
+    perm = [(d, (d + 1) % n) for d in range(n)]
+    q_pos = idx * sq + jnp.arange(sq)            # global query positions
+
+    def accumulate(carry, t, k_cur, v_cur):
+        acc, m, l = carry
+        src = (idx - t) % n                       # owner of current kv block
+        kv_pos = src * sq + jnp.arange(sq)        # global key positions
+        if causal:
+            score_mask = kv_pos[None, :] <= q_pos[:, None]
+            score_mask = score_mask[None, None]   # (1,1,Sq,Skb)
+        else:
+            score_mask = None
+        return _online_block(acc, m, l, q, k_cur, v_cur, scale, score_mask)
+
+    def round_t(t, carry):
+        # rotate first (t >= 1), then accumulate — n-1 rotations total; a
+        # rotate-after-accumulate loop would pay one dead ppermute pair that
+        # XLA cannot eliminate from the loop body.
+        acc, m, l, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        acc, m, l = accumulate((acc, m, l), t, k_cur, v_cur)
+        return acc, m, l, k_cur, v_cur
+
+    acc, m, l = accumulate((acc, m, l), 0, k, v)   # own block, no rotation
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        1, n, round_t, (acc, m, l, k, v))
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def make_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
+                        causal: bool = False, scale: Optional[float] = None):
+    """Build ``f(q, k, v) -> out`` with the sequence dim (axis 2) sharded
+    over ``mesh[axis]``. Exact: matches full attention on the gathered
+    sequence. Assumes S divisible by the axis size (standard for long-context
+    training; pad the sequence otherwise)."""
+    n = mesh.shape[axis]
+
+    def f(q, k, v):
+        nonlocal scale
+        s = q.shape[-1] ** -0.5 if scale is None else scale
+        local = functools.partial(_ring_local, axis=axis, n=n,
+                                  causal=causal, scale=s)
+        spec = P(None, None, axis, None)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return jax.jit(f)
+
+
+def _ulysses_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+    """Per-device body: all_to_all seq-shard → head-shard, full local
+    attention, all_to_all back. Local shapes in: (B, H, S/n, D)."""
+    from ..ops.attention import blockwise_attention
+
+    # (B, H, S/n, D) -> (B, H/n, S, D): split heads across devices, gather seq
+    def swap_in(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def swap_out(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)
+    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    return swap_out(out)
+
+
+def make_ulysses_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
+                           causal: bool = False,
+                           scale: Optional[float] = None):
+    """Build Ulysses-style sequence-parallel attention over ``mesh[axis]``.
+    Requires H divisible by the axis size."""
+    n = mesh.shape[axis]
+
+    def f(q, k, v):
+        if q.shape[1] % n:
+            raise ValueError(
+                f"ulysses needs heads ({q.shape[1]}) divisible by mesh axis "
+                f"{axis!r} size {n}")
+        s = q.shape[-1] ** -0.5 if scale is None else scale
+        local = functools.partial(_ulysses_local, axis=axis, n=n,
+                                  causal=causal, scale=s)
+        spec = P(None, None, axis, None)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return jax.jit(f)
