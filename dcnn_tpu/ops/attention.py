@@ -49,9 +49,16 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def _online_block(acc, m, l, q, k_blk, v_blk, scale, score_mask):
     """One online-softmax accumulation step for query block against one
-    K/V block. Returns updated (acc, m, l). score_mask: (Sq, Skb) or None."""
+    K/V block. Returns updated (acc, m, l). score_mask: (Sq, Skb) or None.
+
+    The running state (acc, m, l) is float32 regardless of input dtype —
+    bf16 statistics lose 8+ bits of softmax mass and fp16 can't even hold
+    the -1e30 mask sentinel — matching the Pallas kernel's fp32 VMEM
+    scratch. Callers cast the final normalised output back to input dtype.
+    """
     s = jnp.einsum("...qd,...kd->...qk", q, k_blk,
-                   precision=get_precision()) * scale
+                   precision=get_precision(),
+                   preferred_element_type=jnp.float32) * scale
     if score_mask is not None:
         s = jnp.where(score_mask, s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -63,7 +70,7 @@ def _online_block(acc, m, l, q, k_blk, v_blk, scale, score_mask):
     l_new = l * correction + jnp.sum(p, axis=-1)
     acc_new = acc * correction[..., None] + jnp.einsum(
         "...qk,...kd->...qd", p.astype(v_blk.dtype), v_blk,
-        precision=get_precision())
+        precision=get_precision(), preferred_element_type=jnp.float32)
     return acc_new, m_new, l_new
 
 
@@ -74,6 +81,12 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Flash-style attention: online softmax over K/V blocks via ``lax.scan``
     — never materialises the (Sq, Sk) score matrix. Exact (not approximate);
     matches :func:`attention` to float tolerance.
+
+    Masking: only ``causal`` is supported on this memory-efficient path (and
+    on :func:`flash_attention`); arbitrary masks require the materialising
+    :func:`attention` oracle. Fully-masked rows return 0 here (zero softmax
+    mass), whereas the oracle returns a uniform average over all positions —
+    callers adding padding masks must not rely on fully-masked-row output.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -105,12 +118,13 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                   score_mask[None, None])
         return (acc, m, l), None
 
-    acc0 = jnp.zeros_like(q)
-    m0 = jnp.full((b, h, sq), NEG_INF, q.dtype)
-    l0 = jnp.zeros((b, h, sq), q.dtype)
+    # fp32 online-softmax state irrespective of q.dtype (see _online_block)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
     (acc, m, l), _ = jax.lax.scan(
         body, (acc0, m0, l0), (kb, vb, jnp.arange(nblk)))
-    return acc / jnp.maximum(l, 1e-30)[..., None]
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -245,16 +259,24 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     block_kv: int = 256, scale: Optional[float] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Pallas flash-attention forward (online softmax, scores stay in VMEM),
-    differentiable via recompute-based VJP. Falls back to
-    :func:`blockwise_attention` when Pallas is unavailable. Off-TPU the
-    kernel runs in interpret mode (slow — tests only).
+    differentiable via recompute-based VJP. Causal-only masking (see
+    :func:`blockwise_attention` docstring). Falls back to
+    :func:`blockwise_attention` — numerically equivalent, same memory
+    profile — when Pallas is unavailable *or* the backend is not TPU;
+    pass ``interpret=True`` explicitly to force the (slow) Pallas
+    interpreter off-TPU for kernel tests.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if not _HAVE_PALLAS:
+        if interpret:
+            raise RuntimeError(
+                "interpret=True requested but Pallas is unavailable in this "
+                "jax build — cannot run the Pallas kernel")
         return blockwise_attention(q, k, v, causal=causal,
                                    block_kv=block_kv, scale=scale)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    if interpret is None and jax.default_backend() != "tpu":
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_kv=block_kv, scale=scale)
     return _flash_attention(q, k, v, causal, block_q, block_kv, float(scale),
-                            interpret)
+                            bool(interpret))

@@ -54,9 +54,11 @@ def _ring_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
     sq = q.shape[2]
     b, h = q.shape[0], q.shape[1]
 
-    acc = jnp.zeros_like(q)
-    m = jnp.full((b, h, sq), NEG_INF, q.dtype)
-    l = jnp.zeros((b, h, sq), q.dtype)
+    # fp32 online-softmax state irrespective of q.dtype (ADVICE r1: bf16
+    # statistics drop softmax mass; fp16 can't hold the NEG_INF sentinel)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
 
     # ppermute perm: device d sends its kv block to d+1, so after t rounds
     # device i holds the block originally owned by (i - t) mod n.
@@ -87,19 +89,34 @@ def _ring_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
     acc, m, l = accumulate((acc, m, l), 0, k, v)   # own block, no rotation
     acc, m, l, _, _ = jax.lax.fori_loop(
         1, n, round_t, (acc, m, l, k, v))
-    return acc / jnp.maximum(l, 1e-30)[..., None]
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def make_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
                         causal: bool = False, scale: Optional[float] = None):
     """Build ``f(q, k, v) -> out`` with the sequence dim (axis 2) sharded
     over ``mesh[axis]``. Exact: matches full attention on the gathered
-    sequence. Assumes S divisible by the axis size (standard for long-context
-    training; pad the sequence otherwise)."""
+    sequence. Requires S divisible by the axis size (standard for
+    long-context training; pad the sequence otherwise).
+
+    Note: causal ring attention currently executes all ``n`` rounds,
+    including rounds whose (q-shard, kv-shard) pair is fully masked
+    (src > idx) — ~2× the necessary FLOPs/ppermute traffic. Skipping or
+    zigzag-rebalancing those rounds is a known future optimisation.
+    """
     n = mesh.shape[axis]
 
     def f(q, k, v):
         nonlocal scale
+        if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
+            raise ValueError(
+                f"ring attention requires equal q/k/v sequence lengths, got "
+                f"Sq={q.shape[2]} Sk={k.shape[2]} Sv={v.shape[2]} (global kv "
+                f"positions are derived from the q shard length)")
+        if q.shape[2] % n:
+            raise ValueError(
+                f"ring attention needs sequence length ({q.shape[2]}) "
+                f"divisible by mesh axis {axis!r} size {n}; pad the sequence")
         s = q.shape[-1] ** -0.5 if scale is None else scale
         local = functools.partial(_ring_local, axis=axis, n=n,
                                   causal=causal, scale=s)
