@@ -1,0 +1,105 @@
+"""Measure the PyTorch baseline train-step throughput and record it.
+
+Analog of the reference's ``torch/`` parity scripts, but it *persists* its
+numbers: runs the exact north-star config (ResNet-18, 64x64, 200 classes,
+fp32, Adam, CrossEntropy) on synthetic in-memory tensors — the same
+isolation ``bench.py`` uses (compute + memory only, no input pipeline) —
+and writes ``BASELINE_MEASURED.json`` at the repo root, which ``bench.py``
+reads to compute ``vs_baseline`` from a *measured* figure instead of an
+estimate.
+
+Run on any host:   python torch_baselines/measure_baseline.py
+GPU recipe:        BASELINE_DEVICE=cuda python torch_baselines/measure_baseline.py
+                   (records a ``torch_cuda`` entry; needs a CUDA build of torch)
+Knobs:             BASELINE_BATCH (default 64 cpu / 256 cuda), BASELINE_STEPS
+                   (default 3 cpu / 30 cuda), BASELINE_DEVICE (cpu|cuda)
+
+Existing entries for other devices are preserved, so CPU and GPU figures can
+be collected on different hosts into the same committed file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from resnet18_tiny import ResNet18Tiny, make_optimizer  # noqa: E402
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "BASELINE_MEASURED.json")
+
+
+def measure(device: str, batch: int, steps: int) -> dict:
+    torch.manual_seed(0)
+    dev = torch.device(device)
+    model = ResNet18Tiny().to(dev).train()
+    opt = make_optimizer(model)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    x = torch.randn(batch, 3, 64, 64, device=dev)
+    y = torch.randint(0, 200, (batch,), device=dev)
+
+    def step():
+        opt.zero_grad(set_to_none=True)
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        return loss
+
+    step()  # warmup (allocator, thread-pool spin-up, cudnn autotune)
+    if device.startswith("cuda"):
+        torch.cuda.synchronize()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    if device.startswith("cuda"):
+        torch.cuda.synchronize()
+    dt = time.perf_counter() - t0
+
+    return {
+        "img_per_sec": round(batch * steps / dt, 2),
+        "sec_per_step": round(dt / steps, 4),
+        "batch": batch,
+        "steps": steps,
+        "final_loss": round(float(loss.detach()), 4),
+        "torch_version": torch.__version__,
+        "torch_threads": torch.get_num_threads(),
+        "host": platform.node(),
+        "cpu_count": os.cpu_count(),
+        "device_name": (torch.cuda.get_device_name(0)
+                        if device.startswith("cuda") else platform.processor() or "cpu"),
+        "config": "resnet18_tiny_imagenet fp32 adam softmax-ce synthetic",
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main() -> None:
+    device = os.environ.get("BASELINE_DEVICE", "cpu")
+    is_cuda = device.startswith("cuda")
+    if is_cuda and not torch.cuda.is_available():
+        print("CUDA requested but unavailable", file=sys.stderr)
+        sys.exit(1)
+    batch = int(os.environ.get("BASELINE_BATCH", "256" if is_cuda else "64"))
+    steps = int(os.environ.get("BASELINE_STEPS", "30" if is_cuda else "3"))
+
+    result = measure(device, batch, steps)
+    key = "torch_cuda" if is_cuda else "torch_cpu"
+
+    data = {}
+    if os.path.exists(OUT_PATH):
+        with open(OUT_PATH) as f:
+            data = json.load(f)
+    data[key] = result
+    with open(OUT_PATH, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(json.dumps({key: result}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""PyTorch ResNet-18 Tiny-ImageNet — the measured-baseline model.
+
+Analog of the reference's ``torch/torch_tiny_imagenet_trainer.py`` model
+section: an independent PyTorch definition of the exact north-star
+architecture (reference ``include/nn/example_models.hpp:306-332``, mirrored
+by ``dcnn_tpu/models/zoo.py:create_resnet18_tiny_imagenet``):
+
+- 32-channel 3x3 stem, bias=False, BatchNorm eps 1e-3, ReLU, 2x2 maxpool
+- 4 stages of basic residual blocks 32->64, 64->64, 64->128(s2), 128->128,
+  128->256(s2), 256->256, 256->512(s2), 512->512
+  (block convs bias=True, BN eps 1e-5; projection shortcut conv bias=False)
+- 4x4 avgpool (stride 1), flatten, fc-200
+
+Used by ``measure_baseline.py`` to produce the measured img/s figure that
+``bench.py`` reports against (BASELINE_MEASURED.json).
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+
+
+class BasicBlock(nn.Module):
+    def __init__(self, cin: int, cout: int, stride: int = 1):
+        super().__init__()
+        self.conv0 = nn.Conv2d(cin, cout, 3, stride, 1, bias=True)
+        self.bn0 = nn.BatchNorm2d(cout, eps=1e-5, momentum=0.1)
+        self.conv1 = nn.Conv2d(cout, cout, 3, 1, 1, bias=True)
+        self.bn1 = nn.BatchNorm2d(cout, eps=1e-5, momentum=0.1)
+        self.relu = nn.ReLU(inplace=True)
+        if stride != 1 or cin != cout:
+            self.shortcut = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, 0, bias=False),
+                nn.BatchNorm2d(cout, eps=1e-5, momentum=0.1),
+            )
+        else:
+            self.shortcut = nn.Identity()
+
+    def forward(self, x):
+        out = self.bn1(self.conv1(self.relu(self.bn0(self.conv0(x)))))
+        return self.relu(out + self.shortcut(x))
+
+
+class ResNet18Tiny(nn.Module):
+    def __init__(self, num_classes: int = 200):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 32, 3, 1, 1, bias=False),
+            nn.BatchNorm2d(32, eps=1e-3, momentum=0.1),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(2, 2),
+        )
+        self.trunk = nn.Sequential(
+            BasicBlock(32, 64, 1), BasicBlock(64, 64, 1),
+            BasicBlock(64, 128, 2), BasicBlock(128, 128, 1),
+            BasicBlock(128, 256, 2), BasicBlock(256, 256, 1),
+            BasicBlock(256, 512, 2), BasicBlock(512, 512, 1),
+        )
+        self.head = nn.Sequential(
+            nn.AvgPool2d(4, 1),
+            nn.Flatten(),
+            nn.Linear(512, num_classes),
+        )
+
+    def forward(self, x):
+        return self.head(self.trunk(self.stem(x)))
+
+
+def make_optimizer(model: nn.Module, lr: float = 1e-3) -> torch.optim.Adam:
+    """Adam with the reference's hyperparameters (beta 0.9/0.999, eps 1e-7 —
+    reference ``torch/torch_tiny_imagenet_trainer.py`` TrainingConfig)."""
+    return torch.optim.Adam(model.parameters(), lr=lr,
+                            betas=(0.9, 0.999), eps=1e-7)
