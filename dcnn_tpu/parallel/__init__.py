@@ -34,6 +34,10 @@ from .compiled_pipeline import (
 from .sequence import (
     SEQ_AXIS, make_ring_attention, make_ulysses_attention, shard_sequence,
 )
+from .distributed_pipeline import (
+    DistributedPipelineCoordinator, PipelineWorkerError,
+)
+from .worker import StageWorker, run_worker
 
 __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
@@ -43,4 +47,6 @@ __all__ = [
     "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",
     "SEQ_AXIS", "make_ring_attention", "make_ulysses_attention",
     "shard_sequence",
+    "DistributedPipelineCoordinator", "PipelineWorkerError",
+    "StageWorker", "run_worker",
 ]

@@ -1,0 +1,178 @@
+"""Framed TCP channels for the cross-process pipeline data/control plane.
+
+Reference equivalent: the ``Communicator`` / ``TcpCommunicator`` /
+``BinarySerializer`` stack (``tcp_communicator.hpp:113-547``,
+``binary_serializer.hpp:25-177``, ``message.hpp:21-166``) — asio io-threads,
+length-prefixed frames, per-CommandType queues.
+
+Design here is deliberately smaller: one blocking socket per peer, a reader
+thread per connection feeding a single inbox queue (the analog of the
+reference's io-thread → ConcurrentMessageMap → cv event loop), and a
+lock-guarded blocking send. On TPU pods the bulk data plane is ICI via XLA
+collectives (SURVEY.md §5.8); this host-path carries stage configs, weights
+and CPU-pipeline activations, so simplicity beats io_uring heroics.
+
+Wire format (original, little-endian):
+  magic  u32  0x44544E31 ("1NTD" on the wire)
+  flags  u8   bit0: payload present
+  meta   u32  length of UTF-8 JSON metadata (always present, has "cmd")
+  payload u64 length of payload blob
+  [meta bytes][payload bytes]
+
+Array payloads ride the ``MetaCompressor`` tensor framing
+(``utils/compression.py`` — rank + dims + dtype + data, codec-id header), so
+activation compression (reference's zstd path, declared-but-unwired there) is
+actually live here: ``Channel(compress=True)`` zstd-compresses any tensor
+payload, and the receiver dispatches by codec id without configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.compression import MetaCompressor, RawCompressor
+
+MAGIC = 0x44544E31
+_HEADER = struct.Struct("<IBIQ")
+_FLAG_PAYLOAD = 1
+
+# module-level codec registry: raw for speed by default, zstd on request
+_CODEC = MetaCompressor()
+_RAW = RawCompressor()
+
+
+class ChannelClosed(ConnectionError):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ChannelClosed("peer closed connection")
+        got += r
+    return bytes(buf)
+
+
+class Channel:
+    """One bidirectional framed connection to a peer."""
+
+    def __init__(self, sock: socket.socket, compress: bool = False):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self.compress = compress
+
+    # -- send --
+    def send(self, cmd: str, meta: Optional[Dict[str, Any]] = None,
+             array: Optional[np.ndarray] = None,
+             raw: Optional[bytes] = None) -> None:
+        m = dict(meta or {})
+        m["cmd"] = cmd
+        payload = b""
+        if array is not None:
+            payload = _CODEC.compress_array(
+                np.asarray(array),
+                codec=None if self.compress else _RAW)
+        elif raw is not None:
+            payload = raw
+            m["_raw"] = True
+        mb = json.dumps(m).encode()
+        flags = _FLAG_PAYLOAD if payload else 0
+        header = _HEADER.pack(MAGIC, flags, len(mb), len(payload))
+        with self._send_lock:
+            self._sock.sendall(header + mb + payload)
+
+    # -- recv (blocking, one frame) --
+    def recv(self) -> Tuple[str, Dict[str, Any], Any]:
+        magic, flags, mlen, plen = _HEADER.unpack(_read_exact(self._sock,
+                                                              _HEADER.size))
+        if magic != MAGIC:
+            raise ConnectionError(f"bad frame magic {magic:#x}")
+        meta = json.loads(_read_exact(self._sock, mlen))
+        payload: Any = None
+        if flags & _FLAG_PAYLOAD:
+            blob = _read_exact(self._sock, plen)
+            payload = blob if meta.pop("_raw", False) \
+                else _CODEC.decompress_array(blob)
+        return meta.pop("cmd"), meta, payload
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class Inbox:
+    """Single arrival-ordered message queue fed by per-connection reader
+    threads (reference: io threads → per-command concurrent queues → cv loop,
+    ``communicator.hpp:84-90``; arrival order suffices because the schedules
+    here are driven end-to-end by the coordinator)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[Tuple[str, Dict, Any, Channel]]" = queue.Queue()
+
+    def attach(self, chan: Channel, on_close=None) -> threading.Thread:
+        def reader():
+            try:
+                while True:
+                    cmd, meta, payload = chan.recv()
+                    self._q.put((cmd, meta, payload, chan))
+            except (ChannelClosed, ConnectionError, OSError):
+                if on_close is not None:
+                    on_close(chan)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        return t
+
+    def get(self, timeout: Optional[float] = None):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError("no message within timeout") from None
+
+
+def listen(port: int, host: str = "0.0.0.0") -> socket.socket:
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(16)
+    return srv
+
+
+def connect(host: str, port: int, *, timeout: float = 60.0,
+            delay: float = 0.2, compress: bool = False) -> Channel:
+    """Connect, retrying until ``timeout`` seconds elapse — workers may come
+    up in any order and can take tens of seconds to import jax on a slow
+    host (the reference retries similarly via asio async_connect +
+    deploy_stages timeouts)."""
+    last: Optional[Exception] = None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            s = socket.create_connection((host, port), timeout=30)
+            return Channel(s, compress=compress)
+        except OSError as e:
+            last = e
+            time.sleep(delay)
+    raise ConnectionError(f"cannot connect to {host}:{port} "
+                          f"within {timeout}s: {last}")
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)

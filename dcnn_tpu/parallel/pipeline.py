@@ -191,6 +191,13 @@ class PipelineStage:
     def clear_cache(self) -> None:
         self._cache.clear()
 
+    def reset_gradients(self) -> None:
+        """Drop accumulated gradients (abort path: a failed batch must not
+        leak partial grads into the next update)."""
+        if self.params is not None:
+            self._grad_acc = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self._grad_count = 0
+
 
 def split_microbatches(x, num_microbatches: int) -> List:
     """Batch → list of microbatches (reference ``split``,

@@ -34,6 +34,21 @@ class Compressor(Protocol):
     def decompress(self, data: bytes, raw_size: int) -> bytes: ...
 
 
+class RawCompressor:
+    """Identity codec (id 0): wire-framed but uncompressed — the right choice
+    for fp32 activations on a fast link, where zstd costs more host time than
+    the bytes it saves. Lets transfer paths pick compression per payload
+    without changing the frame layout."""
+
+    codec_id = 0
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        return data
+
+
 class ZlibCompressor:
     codec_id = 1
 
@@ -72,6 +87,7 @@ class MetaCompressor:
 
     def __init__(self, default: Optional[Compressor] = None):
         self.codecs: Dict[int, Compressor] = {}
+        self.register(RawCompressor())
         zl = ZlibCompressor()
         self.register(zl)
         if _zstd is not None:
@@ -96,12 +112,13 @@ class MetaCompressor:
 
     # -- tensor helpers (reference BinarySerializer tensor framing,
     #    binary_serializer.hpp:27-35: rank + dims + raw data) --
-    def compress_array(self, arr: np.ndarray) -> bytes:
+    def compress_array(self, arr: np.ndarray,
+                       codec: Optional[Compressor] = None) -> bytes:
         arr = np.ascontiguousarray(arr)
         header = struct.pack("<B", arr.ndim) + \
             b"".join(struct.pack("<Q", d) for d in arr.shape) + \
             struct.pack("<4s", np.lib.format.dtype_to_descr(arr.dtype).encode()[:4].ljust(4))
-        return self.compress(header + arr.tobytes())
+        return self.compress(header + arr.tobytes(), codec)
 
     def decompress_array(self, blob: bytes) -> np.ndarray:
         raw = self.decompress(blob)

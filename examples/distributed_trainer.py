@@ -1,0 +1,77 @@
+"""Multi-process pipeline coordinator trainer.
+
+Reference equivalent: ``examples/sync_pipeline_coordinator.cpp`` /
+``semi_async_pipeline_coordinator.cpp`` — the coordinator main that owns the
+full model, deploys stages to ``network_worker.py`` processes over TCP, and
+drives training.
+
+Env: WORKERS (comma-separated host:port list — one stage per worker,
+required), SCHEDULE=sync|semi_async, MODEL (zoo name), NUM_MICROBATCHES,
+plus TrainingConfig vars. See ``launch_pipeline.sh`` for the multi-worker
+launch recipe (the reference's docker-compose analog).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from common import setup  # noqa: E402
+
+from dcnn_tpu.data import SyntheticClassificationLoader  # noqa: E402
+from dcnn_tpu.models import create_model  # noqa: E402
+from dcnn_tpu.optim import Adam  # noqa: E402
+from dcnn_tpu.parallel import (  # noqa: E402
+    DistributedPipelineCoordinator, FlopBalancedPartitioner,
+)
+from dcnn_tpu.ops.metrics import correct_count  # noqa: E402
+from dcnn_tpu.utils.env import get_env  # noqa: E402
+
+
+def main():
+    cfg = setup("distributed_trainer")
+    workers = [w for w in get_env("WORKERS", "").split(",") if w]
+    if not workers:
+        sys.exit("WORKERS=host:port,host:port,... is required")
+    schedule = get_env("SCHEDULE", "semi_async")
+    model_name = get_env("MODEL", "cifar10_cnn_v1")
+
+    model = create_model(model_name)
+    num_classes = model.output_shape()[0]
+    loader = SyntheticClassificationLoader(
+        1024, model.input_shape, num_classes,
+        batch_size=cfg.batch_size, seed=cfg.seed)
+
+    coord = DistributedPipelineCoordinator(
+        model, Adam(cfg.learning_rate), "softmax_crossentropy",
+        workers=workers, partitioner=FlopBalancedPartitioner(),
+        num_microbatches=cfg.num_microbatches or 4, track_load=True)
+    coord.deploy_stages(jax.random.PRNGKey(cfg.seed))
+    print(f"deployed {len(workers)} stages to {workers}, schedule={schedule}")
+
+    fn = (coord.train_batch_semi_async if schedule == "semi_async"
+          else coord.train_batch_sync)
+    try:
+        for epoch in range(1, cfg.epochs + 1):
+            loader.shuffle(epoch)
+            tot_loss = tot_correct = tot_n = 0
+            for bi, (x, y) in enumerate(loader):
+                loss, logits = fn(x, y, cfg.learning_rate,
+                                  jax.random.fold_in(jax.random.PRNGKey(epoch), bi))
+                tot_loss += loss * x.shape[0]
+                tot_correct += int(correct_count(jax.numpy.asarray(logits),
+                                                 jax.numpy.asarray(y)))
+                tot_n += x.shape[0]
+            print(f"epoch {epoch}: loss {tot_loss / tot_n:.4f} "
+                  f"acc {tot_correct / tot_n:.4f}")
+            for sid, rep in enumerate(coord.collect_load_reports()):
+                print(f"  stage {sid}: fwd {rep['avg_forward_ms']:.2f}ms "
+                      f"bwd {rep['avg_backward_ms']:.2f}ms")
+    finally:
+        coord.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Multi-worker pipeline launch recipe — the reference's docker-compose
+# topology (2 workers + coordinator on one machine) as a plain script.
+# Reference: /root/reference/docker-compose.yml (sync / semi-async profiles,
+# cpuset-pinned workers). On real deployments run each line on its own host
+# (or taskset/cgroup-pin them like the reference's cpuset stanzas).
+#
+# Usage: ./launch_pipeline.sh [num_workers] [schedule] [model]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N=${1:-2}
+SCHEDULE=${2:-semi_async}
+MODEL=${3:-cifar10_cnn_v1}
+BASE_PORT=${BASE_PORT:-9601}
+PLATFORM=${DCNN_PLATFORM:-cpu}
+
+PIDS=()
+WORKERS=""
+for i in $(seq 0 $((N - 1))); do
+  PORT=$((BASE_PORT + i))
+  DCNN_PLATFORM=$PLATFORM python examples/network_worker.py --port "$PORT" &
+  PIDS+=($!)
+  WORKERS+="${WORKERS:+,}127.0.0.1:$PORT"
+done
+trap 'kill "${PIDS[@]}" 2>/dev/null || true' EXIT
+
+DCNN_PLATFORM=$PLATFORM WORKERS=$WORKERS SCHEDULE=$SCHEDULE MODEL=$MODEL \
+  EPOCHS=${EPOCHS:-2} python examples/distributed_trainer.py
