@@ -28,8 +28,9 @@ from .pipeline import (
     InProcessPipelineCoordinator, PipelineStage, train_pipeline_batch_sync,
 )
 from .compiled_pipeline import (
-    SequentialStageStack, make_compiled_pipeline_forward,
-    make_compiled_pipeline_train_step, shard_stacked, stack_stage_params,
+    HeteroCompiledPipeline, SequentialStageStack,
+    make_compiled_pipeline_forward, make_compiled_pipeline_train_step,
+    shard_stacked, stack_stage_params,
 )
 from .sequence import (
     SEQ_AXIS, make_ring_attention, make_ulysses_attention, shard_sequence,
@@ -43,7 +44,8 @@ __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
     "make_data_parallel_train_step", "shard_batch", "replicate",
     "PipelineStage", "InProcessPipelineCoordinator", "train_pipeline_batch_sync",
-    "SequentialStageStack", "make_compiled_pipeline_forward",
+    "HeteroCompiledPipeline", "SequentialStageStack",
+    "make_compiled_pipeline_forward",
     "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",
     "SEQ_AXIS", "make_ring_attention", "make_ulysses_attention",
     "shard_sequence",

@@ -165,6 +165,10 @@ def connect(host: str, port: int, *, timeout: float = 60.0,
     while time.monotonic() < deadline:
         try:
             s = socket.create_connection((host, port), timeout=30)
+            # the connect timeout must not linger: a 30s recv stall (jit
+            # compile, idle epoch gap) would look like a peer close to the
+            # reader thread
+            s.settimeout(None)
             return Channel(s, compress=compress)
         except OSError as e:
             last = e

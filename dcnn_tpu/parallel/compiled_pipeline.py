@@ -7,21 +7,38 @@ event-loop semantics for arbitrary heterogeneous stages) and a compiled
 schedule inside one XLA program (this module — fast, rigid). The reference
 has no analog: its TCP message loop *is* the schedule.
 
+The schedule is **GPipe** (fill → steady → drain, all forwards before the
+backward which autodiff runs as the reverse drain) — named honestly: it is
+*not* 1F1B; activation liveness across the scan is inherently
+O(microbatches + stages) tick boundaries per device. What keeps HBM in check
+is the **remat policy** (on by default): each stage application is wrapped in
+``jax.checkpoint``, so only the tick-boundary activations are saved and all
+intra-stage intermediates (conv outputs, BN normalised values, …) are
+recomputed during the backward drain — liveness per device drops from
+O(ticks × stage_depth) to O(ticks) activations.
+
 Design: SPMD over a ``"stage"`` mesh axis with ``shard_map``. Stage weights
 are stacked on a leading axis and sharded so device *i* holds stage *i*'s
 slice; activations rotate device-to-device with ``jax.lax.ppermute`` (ICI
 neighbor hops — the XLA-native replacement for the reference's
 ``send to "next_stage"``). The steady-state loop runs
-``num_microbatches + num_stages - 1`` ticks (GPipe fill + drain); every tick
-is one fused XLA step on all devices, so compute on microbatch *i* overlaps
-the ppermute of microbatch *i±1* with zero host involvement.
+``num_microbatches + num_stages - 1`` ticks; every tick is one fused XLA
+step on all devices, so compute on microbatch *i* overlaps the ppermute of
+microbatch *i±1* with zero host involvement.
 
-Rigidity contract: all stages run the same program, so the model must be a
-stack of ``num_stages`` **identical-structure** blocks (same params pytree,
-same activation shape). That covers the iso-resolution residual trunk of a
-ResNet and transformer-style stacks; heterogeneous splits (stem/downsample/
-head) stay on the host-driven engine, or compose: host-driven outer stages
-around a compiled trunk.
+Two engines:
+
+- **Homogeneous** (``make_compiled_pipeline_*``): all stages share one
+  params pytree structure and a shape-preserving ``stage_fn`` — the
+  zero-overhead path for iso-resolution trunks and transformer stacks.
+- **Heterogeneous** (:class:`HeteroCompiledPipeline`): arbitrary
+  ``Sequential.split`` partitions — different params structures, activation
+  shapes, and BN state per stage. Per-stage pytrees are flattened to padded
+  flat vectors stacked over the stage axis; ``lax.switch`` picks this
+  device's stage program; activations travel as padded flat buffers.
+  Elementwise optimizers (SGD/Adam/…) run directly on the padded flat
+  params, so the update step is also a single sharded elementwise op. This
+  is what lets the flagship ResNet-18 run through a compiled schedule.
 
 Backward runs by autodiff THROUGH the whole scheduled forward: XLA transposes
 the ppermute rotation automatically, yielding the reverse-direction gradient
@@ -61,6 +78,7 @@ def make_compiled_pipeline_forward(
     num_stages: int,
     num_microbatches: int,
     mesh: Mesh,
+    remat: bool = True,
 ):
     """Build ``forward(stacked_params, microbatches) -> outputs`` running the
     GPipe schedule in one jit.
@@ -68,10 +86,15 @@ def make_compiled_pipeline_forward(
     ``stage_fn(stage_params, x) -> y`` is one stage's computation; activation
     shape must be invariant. ``microbatches``: (num_microbatches, mb, ...) —
     replicated input; outputs: same shape, the last stage's results.
+    ``remat=True`` (default) checkpoints each stage application so backward
+    recomputes intra-stage intermediates instead of keeping them live across
+    the whole schedule.
     """
     if num_microbatches < 1:
         raise ValueError("need at least one microbatch")
     total_ticks = num_microbatches + num_stages - 1
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def per_device(params_slice, mbs):
         # params_slice: this device's stage params (leading axis stripped by
@@ -142,8 +165,9 @@ def make_compiled_pipeline_train_step(
     num_stages: int,
     num_microbatches: int,
     mesh: Mesh,
+    remat: bool = True,
 ):
-    """One jitted train step over the compiled schedule:
+    """One jitted train step over the compiled GPipe schedule:
     ``step(stacked_params, opt_state, mb_x, mb_y, lr) ->
     (params, opt_state, loss, outputs)``.
 
@@ -151,7 +175,8 @@ def make_compiled_pipeline_train_step(
     transposes the ppermute rotation into the backward drain); the optimizer
     update runs sharded — each device updates only its stage's slice.
     """
-    fwd = make_compiled_pipeline_forward(stage_fn, num_stages, num_microbatches, mesh)
+    fwd = make_compiled_pipeline_forward(stage_fn, num_stages,
+                                         num_microbatches, mesh, remat=remat)
 
     def loss_of(params, mb_x, mb_y):
         outs = fwd(params, mb_x)
@@ -166,6 +191,221 @@ def make_compiled_pipeline_train_step(
         return new_params, new_opt, loss, outs
 
     return jax.jit(step, donate_argnums=(0, 1))
+
+
+class HeteroCompiledPipeline:
+    """Compiled GPipe schedule for **heterogeneous** stages — the engine that
+    runs the flagship ResNet-18 (different params structure, activation
+    shape, and BN state per stage) inside one jit.
+
+    Mechanism: every stage's params/state pytrees are flattened
+    (``ravel_pytree``) into flat fp32 vectors, zero-padded to the widest
+    stage, and stacked to ``(S, L)`` arrays sharded over the ``stage`` mesh
+    axis. Activations travel between devices as zero-padded flat buffers of
+    the widest microbatch activation; ``lax.switch`` dispatches this device's
+    stage program, which unpacks its statically-shaped slices. Elementwise
+    optimizers run directly on the padded flat params (padding has zero
+    gradient, so it stays zero). BN running stats are carried through the
+    scan and **gated on microbatch validity**, so pipeline-bubble ticks
+    (which compute on garbage buffers) can't pollute statistics; per-stage
+    state updates are sequential over microbatches, matching the host-driven
+    engine and the reference's per-microbatch BN semantics exactly
+    (SURVEY.md §7 hard part 4).
+
+    Numerics parity with :class:`~dcnn_tpu.parallel.pipeline.InProcessPipelineCoordinator`
+    (same init, same loss/grad scaling) is pinned by
+    ``tests/test_compiled_pipeline.py``.
+    """
+
+    def __init__(self, model, num_stages: int, num_microbatches: int,
+                 mesh: Mesh, partitioner=None, remat: bool = True):
+        from jax.flatten_util import ravel_pytree
+
+        from .partitioner import NaivePartitioner
+
+        if model.input_shape is None:
+            raise ValueError("model needs a known input_shape")
+        self.model = model
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.mesh = mesh
+        self.remat = remat
+        self.partitions = (partitioner or NaivePartitioner()).get_partitions(
+            model, num_stages)
+        self.stage_models = model.split(self.partitions)
+        self.in_shapes = [tuple(sm.input_shape) for sm in self.stage_models]
+        self.out_shapes = [tuple(sm.output_shape()) for sm in self.stage_models]
+
+        # templates (shapes only — eval_shape avoids a real init) →
+        # per-stage unravel closures + flat sizes
+        tp, tstate = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        tp = jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), tp)
+        tstate = jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype),
+                                        tstate)
+        sp = model.split_params(tp, self.partitions)
+        ss = model.split_params(tstate, self.partitions)
+        self._unravel_p, self._unravel_s = [], []
+        self.param_sizes, self.state_sizes = [], []
+        for p, s in zip(sp, ss):
+            fp, up = ravel_pytree(p)
+            fs, us = ravel_pytree(s)
+            self._unravel_p.append(up)
+            self._unravel_s.append(us)
+            self.param_sizes.append(fp.size)
+            self.state_sizes.append(fs.size)
+        self.Lp = max(self.param_sizes)
+        self.Ls = max(max(self.state_sizes), 1)
+
+    # -- flat <-> tree helpers --
+    def _pack_stacked(self, per_stage_trees, width):
+        from jax.flatten_util import ravel_pytree
+
+        rows = []
+        for tree in per_stage_trees:
+            flat, _ = ravel_pytree(tree)
+            flat = flat.astype(jnp.float32)
+            rows.append(jnp.pad(flat, (0, width - flat.size)))
+        return jnp.stack(rows)
+
+    def init(self, key: jax.Array):
+        """Init the FULL model once (bit-identical to a single-device run,
+        like the host-driven coordinator) and return sharded
+        ``(flat_params (S,Lp), flat_state (S,Ls))``."""
+        params, state = self.model.init(key)
+        sp = self.model.split_params(params, self.partitions)
+        ss = self.model.split_params(state, self.partitions)
+        fp = self._pack_stacked(sp, self.Lp)
+        fs = self._pack_stacked(ss, self.Ls)
+        return shard_stacked(fp, self.mesh), shard_stacked(fs, self.mesh)
+
+    def unpack_params(self, flat_params, flat_state):
+        """Gather the sharded flat stacks back to per-stage pytrees (for
+        checkpointing / eval on one device)."""
+        fp = jax.device_get(flat_params)
+        fs = jax.device_get(flat_state)
+        ps = [self._unravel_p[i](jnp.asarray(fp[i, :self.param_sizes[i]]))
+              for i in range(self.num_stages)]
+        ss = [self._unravel_s[i](jnp.asarray(fs[i, :self.state_sizes[i]]))
+              for i in range(self.num_stages)]
+        return ps, ss
+
+    # -- the scheduled step --
+    def make_train_step(self, loss_fn, optimizer):
+        """Returns jitted ``step(flat_params, opt_state, flat_state, mb_x,
+        mb_y, rng, lr) -> (flat_params, opt_state, flat_state, loss,
+        logits)``. ``mb_x``: (M, mb, *input_shape); ``mb_y``: (M, mb, ...)."""
+        S, M = self.num_stages, self.num_microbatches
+        total_ticks = M + S - 1
+        in_shapes, out_shapes = self.in_shapes, self.out_shapes
+        psizes, ssizes = self.param_sizes, self.state_sizes
+        unravel_p, unravel_s = self._unravel_p, self._unravel_s
+        stage_models = self.stage_models
+        Lp, Ls = self.Lp, self.Ls
+        # widest per-sample activation crossing any stage boundary (stage-0
+        # input or any stage's output) — the flat rotate-buffer width
+        max_elems = max([_prod(in_shapes[0])] + [_prod(s) for s in out_shapes])
+
+        def scheduled(flat_params1, flat_state1, mbs_flat, rng):
+            # shard_map strips the stage axis to size 1 — squeeze
+            fp = flat_params1[0]
+            fs0 = flat_state1[0]
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            LactTot = mbs_flat.shape[1]
+            mb = LactTot // max_elems
+
+            def make_branch(i):
+                def branch(fpv, fsv, buf, key):
+                    p = unravel_p[i](fpv[:psizes[i]])
+                    s = unravel_s[i](fsv[:ssizes[i]])
+                    x = buf[: mb * _prod(in_shapes[i])].reshape(
+                        mb, *in_shapes[i])
+                    y, s_new = stage_models[i].apply(
+                        p, s, x, training=True, rng=key)
+                    fs_new, _ = _ravel(s_new)
+                    out = jnp.pad(y.reshape(-1).astype(jnp.float32),
+                                  (0, LactTot - mb * _prod(out_shapes[i])))
+                    return out, jnp.pad(fs_new.astype(jnp.float32),
+                                        (0, Ls - fs_new.size))
+                return jax.checkpoint(branch) if self.remat else branch
+
+            branches = [make_branch(i) for i in range(S)]
+
+            def tick(carry, t):
+                buf, fsv, outputs = carry
+                inject = jnp.where(t < M, t, 0)
+                x_in = jnp.where(stage == 0, mbs_flat[inject], buf)
+                mb_idx = jnp.clip(t - stage, 0, M - 1)
+                key = jax.random.fold_in(rng, mb_idx)
+                y_flat, fs_new = jax.lax.switch(
+                    stage, branches, fp, fsv, x_in, key)
+                # bubble ticks compute on garbage: gate the state update on
+                # this tick carrying a real microbatch through this stage
+                valid = jnp.logical_and(t >= stage, t - stage < M)
+                fsv = jnp.where(valid, jax.lax.stop_gradient(fs_new), fsv)
+                out_idx = t - (S - 1)
+                record = jnp.logical_and(stage == S - 1, out_idx >= 0)
+                outputs = jax.lax.cond(
+                    record,
+                    lambda o: jax.lax.dynamic_update_index_in_dim(
+                        o, y_flat, jnp.clip(out_idx, 0, M - 1), 0),
+                    lambda o: o,
+                    outputs)
+                buf = jax.lax.ppermute(
+                    y_flat, STAGE_AXIS,
+                    [(i, (i + 1) % S) for i in range(S)])
+                return (buf, fsv, outputs), None
+
+            buf0 = jnp.zeros((LactTot,), jnp.float32)
+            outputs0 = jnp.zeros((M, LactTot), jnp.float32)
+            (buf, fsv, outputs), _ = jax.lax.scan(
+                tick, (buf0, fs0, outputs0), jnp.arange(total_ticks))
+            outputs = jax.lax.psum(
+                jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+                STAGE_AXIS)
+            return outputs, fsv[None]
+
+        smapped = shard_map(
+            scheduled, mesh=self.mesh,
+            in_specs=(P(STAGE_AXIS), P(STAGE_AXIS), P(), P()),
+            out_specs=(P(), P(STAGE_AXIS)),
+            check_vma=False)
+
+        out_elems = _prod(out_shapes[-1])
+
+        def loss_of(flat_params, flat_state, mbs_flat, mb_y, rng):
+            outputs, new_state = smapped(flat_params, flat_state, mbs_flat, rng)
+            mb = mbs_flat.shape[1] // max_elems
+            logits = outputs[:, : mb * out_elems].reshape(
+                M, mb, *out_shapes[-1])
+            losses = jax.vmap(loss_fn)(logits, mb_y)
+            return jnp.mean(losses), (logits, new_state)
+
+        def step(flat_params, opt_state, flat_state, mb_x, mb_y, rng, lr):
+            mb = mb_x.shape[1]
+            mbs_flat = jnp.pad(
+                mb_x.reshape(M, -1).astype(jnp.float32),
+                ((0, 0), (0, mb * max_elems - mb * _prod(in_shapes[0]))))
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(flat_params, flat_state, mbs_flat,
+                                       mb_y, rng)
+            new_params, new_opt = optimizer.update(grads, opt_state,
+                                                   flat_params, lr)
+            return new_params, new_opt, new_state, loss, logits
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def _prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+def _ravel(tree):
+    from jax.flatten_util import ravel_pytree
+
+    return ravel_pytree(tree)
 
 
 class SequentialStageStack:

@@ -117,8 +117,12 @@ class DistributedPipelineCoordinator:
     def _recv(self) -> Tuple[str, Dict, Any]:
         while True:
             c, meta, payload, _ = self.inbox.get(timeout=self.timeout)
+            # fence only messages that actually carry a generation: an
+            # ERROR_REPORT from a gen-less command (CONFIG_TRANSFER,
+            # UPDATE_PARAMETERS) has gen=None and must never be dropped
+            g = meta.get("gen")
             if c in ("FORWARD_RESULT", "BACKWARD_DONE", "ERROR_REPORT") and \
-                    meta.get("gen", self._gen) != self._gen:
+                    g is not None and g != self._gen:
                 continue  # straggler from a dead batch
             if c == "ERROR_REPORT":
                 self.abort()
@@ -184,7 +188,7 @@ class DistributedPipelineCoordinator:
                                   {"mb_id": i, "gen": self._gen},
                                   array=np.asarray(grad))
             self._join("BACKWARD_DONE", len(mb_x))
-        except (TimeoutError, RuntimeError) as e:
+        except (TimeoutError, RuntimeError, OSError) as e:
             if isinstance(e, PipelineWorkerError):
                 raise  # _recv already aborted
             self._abort_and_reraise(e)
@@ -228,7 +232,7 @@ class DistributedPipelineCoordinator:
                 else:
                     raise RuntimeError(
                         f"unexpected {cmd} during semi-async batch")
-        except (TimeoutError, RuntimeError) as e:
+        except (TimeoutError, RuntimeError, OSError) as e:
             if isinstance(e, PipelineWorkerError):
                 raise
             self._abort_and_reraise(e)
