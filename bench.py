@@ -79,15 +79,22 @@ def _measure(step, ts, x, y, key, steps, reps):
     """Best-of-reps steady-state throughput. Returns (best_seconds, ts):
     the train step donates its TrainState argument, so the rolling state must
     be threaded through every call (a stale reference is a deleted buffer on
-    TPU) and handed back to the caller."""
+    TPU) and handed back to the caller.
+
+    Fenced with a real device->host transfer (``core.fence.hard_fence``),
+    NOT ``block_until_ready`` — on the tunnelled TPU backend the latter can
+    return before execution finishes and produced physically impossible
+    (>6x chip peak) throughput numbers."""
     import jax
+
+    from dcnn_tpu.core.fence import hard_fence
 
     best = float("inf")
     for r in range(reps):
         t0 = time.perf_counter()
         for i in range(steps):
             ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
-        jax.block_until_ready(loss)
+        hard_fence(loss)
         best = min(best, time.perf_counter() - t0)
     return best, ts
 
@@ -114,9 +121,11 @@ def run_config(batch, steps, reps, data_format, profile_dir=None):
     x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
     y = jnp.asarray(np.eye(200, dtype=np.float32)[rng.integers(0, 200, size=batch)])
 
-    # warmup / compile
-    ts, loss, _ = step(ts, x, y, key, 1e-3)
-    jax.block_until_ready(loss)
+    # warmup / compile (a few steps: first-call autotuning + tunnel spin-up)
+    from dcnn_tpu.core.fence import hard_fence
+    for i in range(4):
+        ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, 997 + i), 1e-3)
+    hard_fence(loss)
 
     if profile_dir:
         with jax.profiler.trace(profile_dir):

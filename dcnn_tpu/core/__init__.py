@@ -10,6 +10,7 @@ the "every op returns an async handle" model the reference built by hand
 """
 
 from .device import DeviceManager, default_device, device_count, local_devices
+from .fence import hard_fence
 from .mesh import make_mesh, mesh_axes
 from .config import TrainingConfig
 
@@ -18,6 +19,7 @@ __all__ = [
     "default_device",
     "device_count",
     "local_devices",
+    "hard_fence",
     "make_mesh",
     "mesh_axes",
     "TrainingConfig",

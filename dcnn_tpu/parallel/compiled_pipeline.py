@@ -53,6 +53,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import shard_map
+from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import STAGE_AXIS
@@ -219,8 +220,6 @@ class HeteroCompiledPipeline:
 
     def __init__(self, model, num_stages: int, num_microbatches: int,
                  mesh: Mesh, partitioner=None, remat: bool = True):
-        from jax.flatten_util import ravel_pytree
-
         from .partitioner import NaivePartitioner
 
         if model.input_shape is None:
@@ -258,8 +257,6 @@ class HeteroCompiledPipeline:
 
     # -- flat <-> tree helpers --
     def _pack_stacked(self, per_stage_trees, width):
-        from jax.flatten_util import ravel_pytree
-
         rows = []
         for tree in per_stage_trees:
             flat, _ = ravel_pytree(tree)
@@ -321,7 +318,7 @@ class HeteroCompiledPipeline:
                         mb, *in_shapes[i])
                     y, s_new = stage_models[i].apply(
                         p, s, x, training=True, rng=key)
-                    fs_new, _ = _ravel(s_new)
+                    fs_new, _ = ravel_pytree(s_new)
                     out = jnp.pad(y.reshape(-1).astype(jnp.float32),
                                   (0, LactTot - mb * _prod(out_shapes[i])))
                     return out, jnp.pad(fs_new.astype(jnp.float32),
@@ -400,12 +397,6 @@ def _prod(shape) -> int:
     for d in shape:
         out *= int(d)
     return out
-
-
-def _ravel(tree):
-    from jax.flatten_util import ravel_pytree
-
-    return ravel_pytree(tree)
 
 
 class SequentialStageStack:

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.fence import hard_fence
 from ..nn.sequential import Sequential
 from ..ops.losses import LOSSES
 from ..ops.metrics import correct_count
@@ -157,7 +158,7 @@ class PipelineStage:
             self._cache[mb_id] = (x, self.state, rng)
             self.state = new_state
         if self.track_load:
-            jax.block_until_ready(y)
+            hard_fence(y)  # D2H fence: block_until_ready lies on tunnelled TPU
         self.load.forward_ms += (time.perf_counter() - t0) * 1e3
         self.load.forward_count += 1
         return y
@@ -173,7 +174,7 @@ class PipelineStage:
         self._grad_acc, xgrad = self._bwd(self.params, state, x, rng, grad, self._grad_acc)
         self._grad_count += 1
         if self.track_load:
-            jax.block_until_ready(xgrad)
+            hard_fence(xgrad)
         self.load.backward_ms += (time.perf_counter() - t0) * 1e3
         self.load.backward_count += 1
         return xgrad

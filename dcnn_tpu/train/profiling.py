@@ -7,9 +7,12 @@ CUMULATIVE modes (``train.hpp:37,160-162``).
 
 On TPU, timing *inside* a jitted step is meaningless (XLA fuses across layer
 boundaries), so per-layer timing runs the layer chain eagerly layer-by-layer
-with ``block_until_ready`` — the same numbers the reference's
+with a hard device fence — the same numbers the reference's
 per-layer-sync profiling produces, at the same cost model (a profiling run,
-not the training fast path). For production tracing, ``trace()`` wraps
+not the training fast path). The fence is a device->host transfer
+(``core.fence.hard_fence``), not ``block_until_ready``, which on tunnelled
+TPU backends can return before execution completes and silently produce
+garbage timings. For production tracing, ``trace()`` wraps
 ``jax.profiler`` for xprof/tensorboard.
 """
 
@@ -23,6 +26,7 @@ from typing import Dict, Optional
 import jax
 
 from ..core.config import ProfilerType
+from ..core.fence import hard_fence
 from ..nn.sequential import Sequential
 
 
@@ -32,6 +36,10 @@ class LayerProfiler:
         self.forward_us: Dict[str, float] = defaultdict(float)
         self.backward_us: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        # (direction, id(model), x.shape, training) tuples already warmed;
+        # keyed per model/shape so profiling a second model or a new input
+        # shape gets its own warm pass (fresh executables = fresh compiles)
+        self._warmed: set = set()
 
     def clear(self) -> None:
         self.forward_us.clear()
@@ -44,18 +52,33 @@ class LayerProfiler:
 
     def profile_forward(self, model: Sequential, params, state, x, *,
                         training: bool = False, rng=None):
-        """Run the model layer-by-layer, timing each (device-synced)."""
-        h = x
-        new_state = []
-        for i, layer in enumerate(model.layers):
-            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
-            t0 = time.perf_counter()
-            h, s = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
-            jax.block_until_ready(h)
-            self.forward_us[layer.name] += (time.perf_counter() - t0) * 1e6
-            self.counts[layer.name] += 1
-            new_state.append(s)
-        return h, tuple(new_state)
+        """Run the model layer-by-layer, timing each (device-synced).
+
+        An untimed warm pass runs first so the timed pass measures steady
+        state: the first call to each layer executable AND to the fence's
+        tiny slice executable otherwise pays XLA compile time inside the
+        timed region (the reference profiles steady-state kernels too —
+        CUDA context/module load happens before its timers start)."""
+        def run(record: bool):
+            h = x
+            new_state = []
+            for i, layer in enumerate(model.layers):
+                sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+                t0 = time.perf_counter()
+                h, s = layer.apply(params[i], state[i], h,
+                                   training=training, rng=sub_rng)
+                hard_fence(h)
+                if record:
+                    self.forward_us[layer.name] += (time.perf_counter() - t0) * 1e6
+                    self.counts[layer.name] += 1
+                new_state.append(s)
+            return h, tuple(new_state)
+
+        warm_key = ("fwd", id(model), tuple(x.shape), training)
+        if warm_key not in self._warmed:
+            run(record=False)
+            self._warmed.add(warm_key)
+        return run(record=True)
 
     def profile_backward(self, model: Sequential, params, state, x, grad_out, *,
                          training: bool = True, rng=None):
@@ -68,21 +91,30 @@ class LayerProfiler:
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
             inputs.append(h)
             h, _ = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
-        g = grad_out
-        for i in reversed(range(len(model.layers))):
-            layer = model.layers[i]
-            sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
+        def run(record: bool):
+            g = grad_out
+            for i in reversed(range(len(model.layers))):
+                layer = model.layers[i]
+                sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
 
-            def fwd(p, xin):
-                y, _ = layer.apply(p, state[i], xin, training=training, rng=sub_rng)
-                return y
+                def fwd(p, xin, _layer=layer, _i=i, _rng=sub_rng):
+                    y, _ = _layer.apply(p, state[_i], xin,
+                                        training=training, rng=_rng)
+                    return y
 
-            t0 = time.perf_counter()
-            _, vjp = jax.vjp(fwd, params[i], inputs[i])
-            gp, g = vjp(g)
-            jax.block_until_ready(g)
-            self.backward_us[layer.name] += (time.perf_counter() - t0) * 1e6
-        return g
+                t0 = time.perf_counter()
+                _, vjp = jax.vjp(fwd, params[i], inputs[i])
+                gp, g = vjp(g)
+                hard_fence(g)
+                if record:
+                    self.backward_us[layer.name] += (time.perf_counter() - t0) * 1e6
+            return g
+
+        warm_key = ("bwd", id(model), tuple(x.shape), training)
+        if warm_key not in self._warmed:
+            run(record=False)
+            self._warmed.add(warm_key)
+        return run(record=True)
 
     def summary(self) -> str:
         """Printable table (reference ``print_profiling_summary``,

@@ -204,7 +204,7 @@ def test_hetero_multi_step_loss_decreases(hetero_setup):
     assert losses[-1] < losses[0]
 
 
-def test_hetero_runs_flagship_resnet18(hetero_setup):
+def test_hetero_runs_flagship_resnet18():
     """The flagship ResNet-18 Tiny-ImageNet trains through the compiled
     schedule (VERDICT r1 item 5c) — tiny microbatches, 4 stages."""
     from dcnn_tpu.models import create_resnet18_tiny_imagenet
