@@ -26,8 +26,8 @@ repetitions of BENCH_STEPS steps each, best repetition reported (standard
 throughput practice — the steady-state capability of the chip).
 
 Env knobs: BENCH_BATCH (default 512), BENCH_STEPS (default 20), BENCH_REPS
-(default 3), DCNN_PRECISION (default fast = bf16 MXU passes; "parity" for
-fp32), BENCH_FORMAT (NHWC default — TPU-preferred tiling), BENCH_MATRIX=1
+(default 3), DCNN_PRECISION (default bf16 = mixed-precision activations;
+"fast" = bf16 MXU with fp32 storage; "parity" for fp32), BENCH_FORMAT (NHWC default — TPU-preferred tiling), BENCH_MATRIX=1
 for the layout/dtype sweep, BENCH_PROFILE=/path to dump a jax.profiler trace.
 """
 
@@ -40,7 +40,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-os.environ.setdefault("DCNN_PRECISION", "fast")
+os.environ.setdefault("DCNN_PRECISION", "bf16")
 
 # Peak dense-matmul TFLOP/s per chip, by jax device_kind prefix. bf16 figures;
 # fp32 on the MXU runs at ~1/2 (v5e) via fp32 accumulate of bf16x3 passes —
@@ -159,9 +159,9 @@ def main() -> None:
 
     device_kind = jax.devices()[0].device_kind
     peak = _peak_tflops(device_kind)
-    precision = os.environ.get("DCNN_PRECISION", "fast")
+    precision = os.environ.get("DCNN_PRECISION", "bf16").lower()
     mfu = (round(tflops / peak, 4)
-           if peak and precision == "fast" else None)
+           if peak and precision in ("fast", "bf16") else None)
 
     baseline_kind, baseline = _load_measured_baseline(root)
     if baseline is not None:
@@ -195,7 +195,7 @@ def main() -> None:
         matrix = {f"{data_format}_{precision}": {
             "img_per_sec": round(img_per_sec, 1), "tflops": round(tflops, 2)}}
         for fmt in ("NHWC", "NCHW"):
-            for prec in ("fast", "parity"):
+            for prec in ("bf16", "fast", "parity"):
                 if f"{fmt}_{prec}" in matrix:
                     continue
                 set_precision(prec)  # read at trace time; run_config re-jits

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from .factory import layer_from_config
 from .layer import Layer, Shape
@@ -76,12 +77,23 @@ class Sequential:
               ) -> Tuple[jax.Array, State]:
         """Chain layers (reference forward loop ``sequential.hpp:459-466``).
         Per-layer rng derived with ``fold_in(rng, i)`` so dropout masks are
-        deterministic given one step key."""
+        deterministic given one step key.
+
+        Under the ``bf16`` precision mode (core.precision) the input and each
+        layer's params are cast to bfloat16 at point of use; layer state (BN
+        running statistics) stays fp32, and batch_norm computes its reductions
+        in fp32 internally."""
+        from ..core.precision import cast_to_compute, get_compute_dtype
+
+        cdt = get_compute_dtype()
         h = x
+        if cdt is not None and jnp.issubdtype(h.dtype, jnp.floating) and h.dtype != cdt:
+            h = h.astype(cdt)
         new_state = []
         for i, layer in enumerate(self.layers):
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
-            h, s = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
+            h, s = layer.apply(cast_to_compute(params[i]), state[i], h,
+                               training=training, rng=sub_rng)
             new_state.append(s)
         return h, tuple(new_state)
 

@@ -20,14 +20,44 @@ differentiates the loss value — but they are tested against autodiff.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
+def _f32(a: jax.Array) -> jax.Array:
+    a = jnp.asarray(a)
+    return a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+
+def _loss_fp32(fn):
+    """Loss math always runs in fp32: under the bf16 mixed-precision mode
+    (core/precision.py) models emit bf16 predictions, and logsumexp/softmax
+    in bf16 costs real accuracy. Every consumer (trainer, pipeline
+    coordinators, user code calling get_loss) gets the fp32 boundary here,
+    at the loss itself."""
+    @functools.wraps(fn)
+    def wrapped(pred, targets, *args, **kw):
+        return fn(_f32(pred), _f32(targets), *args, **kw)
+    return wrapped
+
+
+def _grad_fp32(fn):
+    """Gradient twins compute in fp32 but cast the result back to the
+    prediction dtype, so a pipeline backward seed matches the stage's
+    compute dtype (the coordinator feeds it straight into a vjp)."""
+    @functools.wraps(fn)
+    def wrapped(pred, targets, *args, **kw):
+        out = fn(_f32(pred), _f32(targets), *args, **kw)
+        return out.astype(jnp.asarray(pred).dtype)
+    return wrapped
+
+
 # ---------------- classification ----------------
 
+@_loss_fp32
 def cross_entropy(probs: jax.Array, targets: jax.Array, eps: float = 1e-15) -> jax.Array:
     """CE over probability inputs, clamped to [eps, 1-eps]
     (reference ``CrossEntropyLoss``, loss.hpp:59; eps 1e-15)."""
@@ -36,6 +66,7 @@ def cross_entropy(probs: jax.Array, targets: jax.Array, eps: float = 1e-15) -> j
     return jnp.mean(per_sample)
 
 
+@_grad_fp32
 def cross_entropy_grad(probs: jax.Array, targets: jax.Array) -> jax.Array:
     """Reference grad kernel is ``(pred - target)/batch``
     (loss_ops.cpp compute_crossentropy_gradient). NOTE: this is the *fused*
@@ -46,6 +77,7 @@ def cross_entropy_grad(probs: jax.Array, targets: jax.Array) -> jax.Array:
     return (probs - targets) / probs.shape[0]
 
 
+@_loss_fp32
 def softmax_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Stable fused softmax+CE over logits (reference
     ``SoftmaxCrossEntropyLoss``, loss.hpp:122): loss = logsumexp(x) - x[target],
@@ -55,10 +87,12 @@ def softmax_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(per_sample)
 
 
+@_grad_fp32
 def softmax_cross_entropy_grad(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return (jax.nn.softmax(logits, axis=-1) - targets) / logits.shape[0]
 
 
+@_loss_fp32
 def log_softmax_cross_entropy(log_probs: jax.Array, targets: jax.Array) -> jax.Array:
     """CE over log-probability inputs (reference ``LogSoftmaxCrossEntropyLoss``,
     loss.hpp:180) — the model's last layer applies log-softmax."""
@@ -66,6 +100,7 @@ def log_softmax_cross_entropy(log_probs: jax.Array, targets: jax.Array) -> jax.A
     return jnp.mean(per_sample)
 
 
+@_grad_fp32
 def log_softmax_cross_entropy_grad(log_probs: jax.Array, targets: jax.Array) -> jax.Array:
     """Fused like the reference kernel: ``(exp(logp) - t)/batch`` equals the
     end-to-end gradient at the *logits* feeding the log-softmax — i.e. the
@@ -75,22 +110,27 @@ def log_softmax_cross_entropy_grad(log_probs: jax.Array, targets: jax.Array) -> 
 
 # ---------------- regression ----------------
 
+@_loss_fp32
 def mse_loss(pred: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(jnp.square(pred - targets))
 
 
+@_grad_fp32
 def mse_grad(pred: jax.Array, targets: jax.Array) -> jax.Array:
     return 2.0 * (pred - targets) / pred.size
 
 
+@_loss_fp32
 def mae_loss(pred: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(jnp.abs(pred - targets))
 
 
+@_grad_fp32
 def mae_grad(pred: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.sign(pred - targets) / pred.size
 
 
+@_loss_fp32
 def huber_loss(pred: jax.Array, targets: jax.Array, delta: float = 1.0) -> jax.Array:
     """Huber with delta 1.0 default (reference loss.hpp:345)."""
     d = pred - targets
@@ -100,6 +140,7 @@ def huber_loss(pred: jax.Array, targets: jax.Array, delta: float = 1.0) -> jax.A
     return jnp.mean(jnp.where(a <= delta, quad, lin))
 
 
+@_grad_fp32
 def huber_grad(pred: jax.Array, targets: jax.Array, delta: float = 1.0) -> jax.Array:
     d = pred - targets
     g = jnp.where(jnp.abs(d) <= delta, d, delta * jnp.sign(d))

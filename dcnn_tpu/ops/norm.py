@@ -46,23 +46,29 @@ def batch_norm(
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
 
+    # Statistics always accumulate in fp32, whatever the activation dtype —
+    # with bf16 activations (mixed-precision mode) a bf16 mean/var over
+    # N*H*W elements would lose most of its mantissa. XLA fuses the upcast
+    # into the reduction, so no fp32 copy of x is materialized.
+    xf = x.astype(jnp.float32)
     if training:
-        mean = jnp.mean(x, axis=reduce_axes)
+        mean = jnp.mean(xf, axis=reduce_axes)
         # Biased variance for normalization (like the reference's fused kernel);
         # unbiased correction applied to the running estimate like torch.
-        var = jnp.var(x, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
         n = x.size // x.shape[c_axis]
         unbiased = var * (n / max(n - 1, 1))
-        new_mean = (1 - momentum) * running_mean + momentum * mean
-        new_var = (1 - momentum) * running_var + momentum * unbiased
+        new_mean = ((1 - momentum) * running_mean + momentum * mean).astype(running_mean.dtype)
+        new_var = ((1 - momentum) * running_var + momentum * unbiased).astype(running_var.dtype)
     else:
-        mean, var = running_mean, running_var
+        mean, var = (running_mean.astype(jnp.float32),
+                     running_var.astype(jnp.float32))
         new_mean, new_var = running_mean, running_var
 
     inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean.reshape(shape)) * inv.reshape(shape)
-    y = y * gamma.reshape(shape) + beta.reshape(shape)
-    return y, new_mean, new_var
+    y = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    y = y * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype), new_mean, new_var
 
 
 def group_norm(
@@ -84,12 +90,12 @@ def group_norm(
     n, c, h, w = x.shape
     if c % num_groups != 0:
         raise ValueError(f"channels {c} not divisible by groups {num_groups}")
-    xg = x.reshape(n, num_groups, c // num_groups, h, w)
+    xg = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, h, w)
     mean = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
     var = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
     y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(n, c, h, w)
     if gamma is not None:
-        y = y * gamma.reshape(1, c, 1, 1)
+        y = y * gamma.astype(jnp.float32).reshape(1, c, 1, 1)
     if beta is not None:
-        y = y + beta.reshape(1, c, 1, 1)
-    return y
+        y = y + beta.astype(jnp.float32).reshape(1, c, 1, 1)
+    return y.astype(x.dtype)

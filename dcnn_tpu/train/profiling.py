@@ -27,6 +27,16 @@ import jax
 
 from ..core.config import ProfilerType
 from ..core.fence import hard_fence
+from ..core.precision import cast_to_compute, get_compute_dtype, get_precision_mode
+
+
+def _cast_input(x):
+    """Input cast matching Sequential.apply's bf16-mode entry cast."""
+    import jax.numpy as jnp
+    cdt = get_compute_dtype()
+    if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdt:
+        return x.astype(cdt)
+    return x
 from ..nn.sequential import Sequential
 
 
@@ -60,12 +70,15 @@ class LayerProfiler:
         timed region (the reference profiles steady-state kernels too —
         CUDA context/module load happens before its timers start)."""
         def run(record: bool):
-            h = x
+            # Mirror Sequential.apply's precision policy (input + per-layer
+            # param casts) so bf16-mode timings measure the bf16 path, not
+            # the fp32 one the mode exists to avoid.
+            h = _cast_input(x)
             new_state = []
             for i, layer in enumerate(model.layers):
                 sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
                 t0 = time.perf_counter()
-                h, s = layer.apply(params[i], state[i], h,
+                h, s = layer.apply(cast_to_compute(params[i]), state[i], h,
                                    training=training, rng=sub_rng)
                 hard_fence(h)
                 if record:
@@ -74,7 +87,11 @@ class LayerProfiler:
                 new_state.append(s)
             return h, tuple(new_state)
 
-        warm_key = ("fwd", id(model), tuple(x.shape), training)
+        # Key on the model object itself (not id(): reuse after GC would alias)
+        # plus everything that changes the compiled executable — shape, dtype,
+        # mode, and the precision policy (a bf16 re-profile must re-warm).
+        warm_key = ("fwd", model, tuple(x.shape), str(x.dtype), training,
+                    get_precision_mode())
         if warm_key not in self._warmed:
             run(record=False)
             self._warmed.add(warm_key)
@@ -84,21 +101,23 @@ class LayerProfiler:
                          training: bool = True, rng=None):
         """Per-layer backward timing via per-layer vjp (mirrors the
         reference's reverse loop timing, sequential.hpp:562-572)."""
-        # forward pass saving per-layer inputs
-        h = x
+        # forward pass saving per-layer inputs (compute-dtype path, like
+        # Sequential.apply)
+        h = _cast_input(x)
         inputs = []
         for i, layer in enumerate(model.layers):
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
             inputs.append(h)
-            h, _ = layer.apply(params[i], state[i], h, training=training, rng=sub_rng)
+            h, _ = layer.apply(cast_to_compute(params[i]), state[i], h,
+                               training=training, rng=sub_rng)
         def run(record: bool):
-            g = grad_out
+            g = grad_out.astype(h.dtype)
             for i in reversed(range(len(model.layers))):
                 layer = model.layers[i]
                 sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
 
                 def fwd(p, xin, _layer=layer, _i=i, _rng=sub_rng):
-                    y, _ = _layer.apply(p, state[_i], xin,
+                    y, _ = _layer.apply(cast_to_compute(p), state[_i], xin,
                                         training=training, rng=_rng)
                     return y
 
@@ -110,7 +129,8 @@ class LayerProfiler:
                     self.backward_us[layer.name] += (time.perf_counter() - t0) * 1e6
             return g
 
-        warm_key = ("bwd", id(model), tuple(x.shape), training)
+        warm_key = ("bwd", model, tuple(x.shape), str(x.dtype), training,
+                    get_precision_mode())
         if warm_key not in self._warmed:
             run(record=False)
             self._warmed.add(warm_key)

@@ -73,6 +73,10 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
 
     def forward_loss(params, state, x, y, rng):
         logits, new_state = model.apply(params, state, x, training=True, rng=rng)
+        # The repo losses upcast internally (ops/losses._loss_fp32 is the fp32
+        # boundary); this cast covers *custom* loss_fns and fixes the dtype of
+        # the logits handed back to callers (metrics consume fp32).
+        logits = logits.astype(jnp.float32)
         return loss_fn(logits, y), (logits, new_state)
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
@@ -121,6 +125,7 @@ def make_eval_step(model: Sequential, loss_fn: Callable):
     @jax.jit
     def eval_step(params, state, x, y):
         logits, _ = model.apply(params, state, x, training=False)
+        logits = logits.astype(jnp.float32)
         return loss_fn(logits, y), correct_count(logits, y)
 
     return eval_step
@@ -201,29 +206,18 @@ class Trainer:
                 # sequential.hpp:323-418).
                 self.profiler.maybe_clear_per_batch()
                 for x, y in train_loader:
+                    # LayerProfiler runs its own untimed warm pass per
+                    # (model, shape, dtype, precision) before timing, so one
+                    # profiled fwd/bwd here is steady-state.
                     x = jnp.asarray(x)
-                    for warmup in (True, False):
-                        if warmup:
-                            # snapshot so discarding the compile-heavy warmup
-                            # pass doesn't wipe CUMULATIVE-mode history
-                            snap = (dict(self.profiler.forward_us),
-                                    dict(self.profiler.backward_us),
-                                    dict(self.profiler.counts))
-                        logits, _ = self.profiler.profile_forward(
-                            self.model, ts.params, ts.state, x,
-                            training=True, rng=epoch_rng)
-                        grad = jax.grad(
-                            lambda out: self.loss_fn(out, jnp.asarray(y)))(logits)
-                        self.profiler.profile_backward(
-                            self.model, ts.params, ts.state, x, grad,
-                            rng=epoch_rng)
-                        if warmup:
-                            for store, saved in zip(
-                                    (self.profiler.forward_us,
-                                     self.profiler.backward_us,
-                                     self.profiler.counts), snap):
-                                store.clear()
-                                store.update(saved)
+                    logits, _ = self.profiler.profile_forward(
+                        self.model, ts.params, ts.state, x,
+                        training=True, rng=epoch_rng)
+                    grad = jax.grad(
+                        lambda out: self.loss_fn(out, jnp.asarray(y)))(logits)
+                    self.profiler.profile_backward(
+                        self.model, ts.params, ts.state, x, grad,
+                        rng=epoch_rng)
                     break
                 print(self.profiler.summary(), flush=True)
 

@@ -1,0 +1,131 @@
+"""Mixed-precision (bf16) mode tests.
+
+The reference trains in pure fp32 (SURVEY.md §7 hard part 6); the TPU-native
+framework adds a ``bf16`` mode (core/precision.py) where activations and
+params-at-use are bfloat16 while master params, optimizer state, BN running
+statistics and the loss stay fp32. These tests pin the invariants that make
+that mode safe.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dcnn_tpu.core.precision import (
+    cast_to_compute, get_compute_dtype, set_precision)
+from dcnn_tpu.models import create_resnet9_cifar10
+from dcnn_tpu.nn.builder import SequentialBuilder
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.ops.norm import batch_norm
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train import make_train_step
+from dcnn_tpu.train.trainer import create_train_state
+
+
+@pytest.fixture
+def bf16_mode():
+    set_precision("bf16")
+    yield
+    set_precision("parity")
+
+
+def _tiny_model():
+    return (SequentialBuilder(data_format="NHWC")
+            .input((8, 8, 3))
+            .conv2d(16, 3, padding=1).batchnorm().activation("relu")
+            .maxpool2d(2)
+            .flatten().dense(10)
+            .build())
+
+
+def test_compute_dtype_selection(bf16_mode):
+    assert get_compute_dtype() == jnp.bfloat16
+    set_precision("parity")
+    assert get_compute_dtype() is None
+
+
+def test_cast_to_compute_only_floats(bf16_mode):
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+    out = cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32
+
+
+def test_bf16_forward_emits_bf16_fp32_state(bf16_mode):
+    model = _tiny_model()
+    key = jax.random.PRNGKey(0)
+    params, state = model.init(key)
+    x = jnp.ones((4, 8, 8, 3), jnp.float32)
+    y, new_state = model.apply(params, state, x, training=True, rng=key)
+    assert y.dtype == jnp.bfloat16
+    # BN running stats must remain fp32 master copies
+    bn_state = [s for s in new_state if s and "running_mean" in s][0]
+    assert bn_state["running_mean"].dtype == jnp.float32
+    assert bn_state["running_var"].dtype == jnp.float32
+
+
+def test_bf16_train_step_keeps_fp32_masters_and_learns(bf16_mode):
+    model = _tiny_model()
+    opt = Adam(1e-2)
+    key = jax.random.PRNGKey(0)
+    ts = create_train_state(model, opt, key)
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 8, 8, 3)).astype(np.float32))
+    labels = rng.integers(0, 10, size=32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[labels])
+
+    losses = []
+    for i in range(30):
+        ts, loss, logits = step(ts, x, y, jax.random.fold_in(key, i), 1e-2)
+        losses.append(float(loss))
+    # loss is computed in fp32 and must drop on a memorizable batch
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5
+    # master params and optimizer state stay fp32
+    for leaf in jax.tree_util.tree_leaves(ts.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(ts.opt_state):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    assert logits.dtype == jnp.float32
+
+
+def test_batch_norm_bf16_stats_accuracy():
+    """bf16 input, but statistics must be fp32-accurate: compare against the
+    fp32 batch_norm on the same (bf16-rounded) data."""
+    rng = np.random.default_rng(1)
+    # large-ish spatial so a bf16 accumulator would visibly drift
+    x32 = jnp.asarray(rng.normal(3.0, 1.0, size=(8, 16, 16, 32)).astype(np.float32))
+    xb = x32.astype(jnp.bfloat16)
+    g = jnp.ones((32,), jnp.float32)
+    b = jnp.zeros((32,), jnp.float32)
+    rm = jnp.zeros((32,), jnp.float32)
+    rv = jnp.ones((32,), jnp.float32)
+
+    y_ref, m_ref, v_ref = batch_norm(
+        xb.astype(jnp.float32), g, b, rm, rv, training=True, data_format="NHWC")
+    y_b, m_b, v_b = batch_norm(
+        xb, g, b, rm, rv, training=True, data_format="NHWC")
+    # running stats identical (both computed in fp32 from identical values)
+    np.testing.assert_allclose(np.asarray(m_b), np.asarray(m_ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_b), np.asarray(v_ref), rtol=1e-6)
+    # normalized output agrees to bf16 resolution
+    np.testing.assert_allclose(
+        np.asarray(y_b, dtype=np.float32), np.asarray(y_ref), atol=0.05)
+
+
+def test_bf16_resnet9_step_runs(bf16_mode):
+    """Flagship-family model compiles and steps in bf16 on the CPU mesh."""
+    model = create_resnet9_cifar10("NHWC")
+    opt = Adam(1e-3)
+    key = jax.random.PRNGKey(0)
+    ts = create_train_state(model, opt, key)
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    x = jnp.zeros((8, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[np.zeros(8, dtype=int)])
+    ts, loss, _ = step(ts, x, y, key, 1e-3)
+    assert np.isfinite(float(loss))
