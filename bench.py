@@ -27,7 +27,10 @@ throughput practice — the steady-state capability of the chip).
 
 Env knobs: BENCH_BATCH (default 512), BENCH_STEPS (default 20), BENCH_REPS
 (default 3), DCNN_PRECISION (default bf16 = mixed-precision activations;
-"fast" = bf16 MXU with fp32 storage; "parity" for fp32), BENCH_FORMAT (NHWC default — TPU-preferred tiling), BENCH_MATRIX=1
+"fast" = bf16 MXU with fp32 storage; "parity" for fp32), BENCH_CHUNK
+(train steps per device dispatch via the in-jit train loop
+train.make_multi_step; default 1 — measured equal to chunked dispatch here,
+the async dispatch queue already hides per-step launch latency), BENCH_FORMAT (NHWC default — TPU-preferred tiling), BENCH_MATRIX=1
 for the layout/dtype sweep, BENCH_PROFILE=/path to dump a jax.profiler trace.
 """
 
@@ -99,7 +102,7 @@ def _measure(step, ts, x, y, key, steps, reps):
     return best, ts
 
 
-def run_config(batch, steps, reps, data_format, profile_dir=None):
+def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -107,31 +110,46 @@ def run_config(batch, steps, reps, data_format, profile_dir=None):
     from dcnn_tpu.models import create_resnet18_tiny_imagenet
     from dcnn_tpu.optim import Adam
     from dcnn_tpu.ops.losses import softmax_cross_entropy
-    from dcnn_tpu.train import make_train_step
+    from dcnn_tpu.train import make_multi_step, make_train_step
     from dcnn_tpu.train.trainer import create_train_state
 
     model = create_resnet18_tiny_imagenet(data_format)
     opt = Adam(1e-3)
     key = jax.random.PRNGKey(0)
     ts = create_train_state(model, opt, key)
-    step = make_train_step(model, softmax_cross_entropy, opt)
 
     shape = (batch, 3, 64, 64) if data_format == "NCHW" else (batch, 64, 64, 3)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
-    y = jnp.asarray(np.eye(200, dtype=np.float32)[rng.integers(0, 200, size=batch)])
+
+    if chunk > 1:
+        # K distinct batches per dispatch: the in-jit train loop
+        # (train.make_multi_step) — one executable launch per K steps.
+        steps = max(chunk, (steps // chunk) * chunk)
+        kshape = (chunk,) + shape
+        xs = jnp.asarray(rng.normal(size=kshape).astype(np.float32))
+        ys = jnp.asarray(np.eye(200, dtype=np.float32)[
+            rng.integers(0, 200, size=(chunk, batch))])
+        multi = make_multi_step(model, softmax_cross_entropy, opt)
+        step = lambda ts_, x_, y_, rng_, lr_: multi(ts_, x_, y_, rng_, lr_) + (None,)
+        x, y = xs, ys
+        dispatches = steps // chunk
+    else:
+        x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        y = jnp.asarray(np.eye(200, dtype=np.float32)[rng.integers(0, 200, size=batch)])
+        step = make_train_step(model, softmax_cross_entropy, opt)
+        dispatches = steps
 
     # warmup / compile (a few steps: first-call autotuning + tunnel spin-up)
     from dcnn_tpu.core.fence import hard_fence
-    for i in range(4):
+    for i in range(2 if chunk > 1 else 4):
         ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, 997 + i), 1e-3)
     hard_fence(loss)
 
     if profile_dir:
         with jax.profiler.trace(profile_dir):
-            _, ts = _measure(step, ts, x, y, key, min(steps, 5), 1)
+            _, ts = _measure(step, ts, x, y, key, min(dispatches, 5), 1)
 
-    dt, ts = _measure(step, ts, x, y, key, steps, reps)
+    dt, ts = _measure(step, ts, x, y, key, dispatches, reps)
     img_per_sec = batch * steps / dt
 
     # analytic training FLOPs: fwd + bwd ~= 3x forward (standard convention;
@@ -153,9 +171,10 @@ def main() -> None:
     reps = int(os.environ.get("BENCH_REPS", "3"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
     profile_dir = os.environ.get("BENCH_PROFILE")
+    chunk = int(os.environ.get("BENCH_CHUNK", "1"))
 
     img_per_sec, sec_per_step, tflops = run_config(
-        batch, steps, reps, data_format, profile_dir)
+        batch, steps, reps, data_format, profile_dir, chunk=chunk)
 
     device_kind = jax.devices()[0].device_kind
     peak = _peak_tflops(device_kind)
@@ -187,6 +206,7 @@ def main() -> None:
         "batch": batch,
         "format": data_format,
         "precision": precision,
+        "steps_per_dispatch": chunk,
     }
 
     if os.environ.get("BENCH_MATRIX"):

@@ -18,7 +18,6 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 
 from .factory import layer_from_config
 from .layer import Layer, Shape
@@ -83,12 +82,9 @@ class Sequential:
         layer's params are cast to bfloat16 at point of use; layer state (BN
         running statistics) stays fp32, and batch_norm computes its reductions
         in fp32 internally."""
-        from ..core.precision import cast_to_compute, get_compute_dtype
+        from ..core.precision import cast_to_compute
 
-        cdt = get_compute_dtype()
-        h = x
-        if cdt is not None and jnp.issubdtype(h.dtype, jnp.floating) and h.dtype != cdt:
-            h = h.astype(cdt)
+        h = cast_to_compute(x)
         new_state = []
         for i, layer in enumerate(self.layers):
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None

@@ -4,12 +4,12 @@ from .checkpoint import load_checkpoint, save_checkpoint
 from .profiling import LayerProfiler
 from .trainer import (
     Trainer, TrainState, evaluate_classification, make_eval_step,
-    make_train_step, train_classification_model,
+    make_multi_step, make_train_step, train_classification_model,
 )
 
 __all__ = [
     "save_checkpoint", "load_checkpoint",
     "LayerProfiler",
     "Trainer", "TrainState", "make_train_step", "make_eval_step",
-    "train_classification_model", "evaluate_classification",
+    "make_multi_step", "train_classification_model", "evaluate_classification",
 ]

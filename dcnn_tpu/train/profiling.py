@@ -27,16 +27,7 @@ import jax
 
 from ..core.config import ProfilerType
 from ..core.fence import hard_fence
-from ..core.precision import cast_to_compute, get_compute_dtype, get_precision_mode
-
-
-def _cast_input(x):
-    """Input cast matching Sequential.apply's bf16-mode entry cast."""
-    import jax.numpy as jnp
-    cdt = get_compute_dtype()
-    if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cdt:
-        return x.astype(cdt)
-    return x
+from ..core.precision import cast_to_compute, get_precision_mode
 from ..nn.sequential import Sequential
 
 
@@ -46,9 +37,10 @@ class LayerProfiler:
         self.forward_us: Dict[str, float] = defaultdict(float)
         self.backward_us: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
-        # (direction, id(model), x.shape, training) tuples already warmed;
-        # keyed per model/shape so profiling a second model or a new input
-        # shape gets its own warm pass (fresh executables = fresh compiles)
+        # (direction, model, x.shape, x.dtype, training, precision-mode)
+        # tuples already warmed — everything that changes the compiled
+        # executable gets its own warm pass. Holding the model object (not
+        # id()) also pins it against GC id-reuse aliasing.
         self._warmed: set = set()
 
     def clear(self) -> None:
@@ -73,7 +65,7 @@ class LayerProfiler:
             # Mirror Sequential.apply's precision policy (input + per-layer
             # param casts) so bf16-mode timings measure the bf16 path, not
             # the fp32 one the mode exists to avoid.
-            h = _cast_input(x)
+            h = cast_to_compute(x)
             new_state = []
             for i, layer in enumerate(model.layers):
                 sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
@@ -103,7 +95,7 @@ class LayerProfiler:
         reference's reverse loop timing, sequential.hpp:562-572)."""
         # forward pass saving per-layer inputs (compute-dtype path, like
         # Sequential.apply)
-        h = _cast_input(x)
+        h = cast_to_compute(x)
         inputs = []
         for i, layer in enumerate(model.layers):
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None

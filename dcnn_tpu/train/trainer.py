@@ -115,13 +115,48 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-@functools.lru_cache(maxsize=64)
+def make_multi_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
+                    num_microbatches: int = 1, donate: bool = True):
+    """Returns jitted ``multi_step(ts, xs, ys, rng, lr) -> (ts, mean_loss)``
+    running ``xs.shape[0]`` full train steps in ONE device dispatch via
+    ``lax.scan`` (``xs``: [K, B, ...], ``ys``: [K, B, classes]).
+
+    The TPU-idiomatic "train loop inside jit": one executable launch per K
+    batches amortizes host dispatch latency (significant on remote/tunnelled
+    TPU hosts), and pairs with a prefetching loader that stages K batches
+    into HBM while the previous chunk trains. Semantics are identical to K
+    sequential ``make_train_step`` calls (per-batch BN stats, per-batch
+    optimizer updates, per-step folded rng) — only the dispatch granularity
+    changes. The reference has no analog (its CUDA stream dispatch is local
+    and cheap); this is pure TPU-runtime design."""
+    base = make_train_step(model, loss_fn, optimizer,
+                           num_microbatches=num_microbatches, jit=False)
+
+    def multi_step(ts: TrainState, xs, ys, rng, lr):
+        def body(carry, xyi):
+            x, y, i = xyi
+            new_ts, loss, _ = base(carry, x, y, jax.random.fold_in(rng, i), lr)
+            return new_ts, loss
+
+        ts, losses = jax.lax.scan(
+            body, ts, (xs, ys, jnp.arange(xs.shape[0])))
+        return ts, jnp.mean(losses)
+
+    return jax.jit(multi_step, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(model: Sequential, loss_fn: Callable):
     """Jitted ``eval_step(params, state, x, y) -> (loss, correct)``
     (reference ``validate_class_model``, train.hpp:172). Memoized on
-    (model, loss_fn) identity so per-epoch validation reuses one compiled
-    step instead of re-jitting every call."""
+    (model, loss_fn, precision-mode) so per-epoch validation reuses one
+    compiled step — and a ``set_precision`` change re-traces instead of
+    silently serving the old mode's executable."""
+    from ..core.precision import get_precision_mode
+    return _make_eval_step_cached(model, loss_fn, get_precision_mode())
 
+
+@functools.lru_cache(maxsize=64)
+def _make_eval_step_cached(model: Sequential, loss_fn: Callable, _mode: str):
     @jax.jit
     def eval_step(params, state, x, y):
         logits, _ = model.apply(params, state, x, training=False)
@@ -253,8 +288,14 @@ class Trainer:
         return ts
 
 
-@functools.lru_cache(maxsize=64)
 def _make_regression_eval_step(model: Sequential, loss_fn: Callable):
+    from ..core.precision import get_precision_mode
+    return _make_regression_eval_step_cached(model, loss_fn, get_precision_mode())
+
+
+@functools.lru_cache(maxsize=64)
+def _make_regression_eval_step_cached(model: Sequential, loss_fn: Callable,
+                                      _mode: str):
     @jax.jit
     def eval_step(params, state, x, y):
         pred, _ = model.apply(params, state, x, training=False)

@@ -129,3 +129,46 @@ def test_bf16_resnet9_step_runs(bf16_mode):
     y = jnp.asarray(np.eye(10, dtype=np.float32)[np.zeros(8, dtype=int)])
     ts, loss, _ = step(ts, x, y, key, 1e-3)
     assert np.isfinite(float(loss))
+
+
+def test_multi_step_matches_sequential_steps():
+    """make_multi_step(K batches, one dispatch) must be semantically identical
+    to K sequential make_train_step calls (per-batch BN stats + updates)."""
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.train import make_multi_step, make_train_step
+
+    model = _tiny_model()
+    # SGD+momentum, not Adam: Adam's m/(sqrt(v)+eps) amplifies the
+    # reassociation-level numeric noise between the scanned and unrolled
+    # compilations by orders of magnitude while v ~ 0, which would force a
+    # meaninglessly loose tolerance here.
+    opt = SGD(1e-2, momentum=0.9)
+    key = jax.random.PRNGKey(0)
+    ts_a = create_train_state(model, opt, key)
+    ts_b = create_train_state(model, opt, key)
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    multi = make_multi_step(model, softmax_cross_entropy, opt, donate=False)
+
+    rng = np.random.default_rng(2)
+    K, B = 3, 8
+    xs = jnp.asarray(rng.normal(size=(K, B, 8, 8, 3)).astype(np.float32))
+    ys = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, size=(K, B))])
+
+    losses = []
+    data_rng = jax.random.PRNGKey(7)
+    for i in range(K):
+        ts_a, loss, _ = step(ts_a, xs[i], ys[i],
+                             jax.random.fold_in(data_rng, i), 1e-3)
+        losses.append(float(loss))
+    ts_b, mean_loss = multi(ts_b, xs, ys, data_rng, 1e-3)
+
+    np.testing.assert_allclose(float(mean_loss), np.mean(losses), rtol=1e-5)
+    # scan-vs-unrolled compiles different fusion orders, so allow
+    # reassociation-level noise only.
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.params),
+                    jax.tree_util.tree_leaves(ts_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.state),
+                    jax.tree_util.tree_leaves(ts_b.state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
