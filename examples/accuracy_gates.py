@@ -1,0 +1,212 @@
+"""End-to-end accuracy gates on real data (SURVEY.md Stage 1 success gate:
+"MNIST >= 99% test accuracy"; reference training semantics
+``include/nn/train.hpp:202-308``).
+
+Gates:
+  digits   — sklearn's bundled handwritten-digits set (real data, available
+             offline in any environment): small CNN, target >= 0.95 test acc.
+  mnist    — MNIST CSV (data/mnist/train.csv, test.csv): reference MNIST CNN,
+             target >= 0.99 test acc.
+  cifar10  — CIFAR-10 binary batches: resnet9, top-1 recorded (reference
+             publishes no number; the measured value becomes the baseline).
+
+Each gate trains with the normal Trainer path, then appends a row to
+RESULTS.md and a record to RESULTS.json at the repo root (dataset, model,
+epochs, wall-clock, accuracy, device, precision mode, pass/fail). Gates whose
+dataset is absent are recorded as skipped with the exact download command
+(python -m dcnn_tpu.data.download ... — zero-egress environments run it on a
+connected host and copy data/ over).
+
+Usage: python examples/accuracy_gates.py [digits mnist cifar10]
+Env: EPOCHS_DIGITS / EPOCHS_MNIST / EPOCHS_CIFAR10 override epoch counts;
+DCNN_PRECISION selects the precision mode (default bf16 on TPU, parity
+elsewhere).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from common import setup
+
+import numpy as np
+
+import dcnn_tpu  # noqa: F401  (platform override side effects)
+import jax
+
+from dcnn_tpu.core.precision import get_precision_mode, set_precision
+from dcnn_tpu.data import ArrayDataLoader
+from dcnn_tpu.nn.builder import SequentialBuilder
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train import Trainer
+from dcnn_tpu.train.trainer import create_train_state, evaluate_classification
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.utils.env import get_env
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_and_eval(name, model, train_loader, val_loader, *, epochs, lr,
+                    target):
+    from dcnn_tpu.core.config import TrainingConfig
+
+    t0 = time.perf_counter()
+    opt = Adam(lr)
+    cfg = TrainingConfig(learning_rate=lr, snapshot_dir=None)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
+    ts = trainer.fit(ts, train_loader, val_loader, epochs=epochs)
+    wall = time.perf_counter() - t0
+    val_loss, val_acc = evaluate_classification(
+        model, ts.params, ts.state, softmax_cross_entropy, val_loader)
+    return {
+        "gate": name,
+        "model": model.name,
+        "epochs": epochs,
+        "batch_size": train_loader.batch_size,
+        "train_samples": train_loader.num_samples,
+        "val_samples": val_loader.num_samples,
+        "val_acc": round(float(val_acc), 4),
+        "val_loss": round(float(val_loss), 4),
+        "target": target,
+        "passed": bool(val_acc >= target),
+        "wall_clock_s": round(wall, 1),
+        "device": jax.devices()[0].device_kind,
+        "precision": get_precision_mode(),
+    }
+
+
+def gate_digits():
+    """Real handwritten digits (sklearn bundled copy of UCI optdigits 8x8)."""
+    from sklearn.datasets import load_digits
+
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32).reshape(-1, 8, 8, 1)
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(len(X))
+    n_test = len(X) // 5
+    test_idx, train_idx = idx[:n_test], idx[n_test:]
+
+    def onehot(labels):
+        return np.eye(10, dtype=np.float32)[labels]
+
+    train = ArrayDataLoader(X[train_idx], onehot(y[train_idx]), batch_size=64,
+                            seed=0)
+    val = ArrayDataLoader(X[test_idx], onehot(y[test_idx]), batch_size=256,
+                          shuffle=False, drop_last=False)
+    train.load_data(); val.load_data()
+
+    model = (SequentialBuilder(name="digits_cnn", data_format="NHWC")
+             .input((8, 8, 1))
+             .conv2d(16, 3, padding=1).batchnorm().activation("relu")
+             .conv2d(32, 3, padding=1).batchnorm().activation("relu")
+             .maxpool2d(2)
+             .flatten().dense(64).activation("relu").dense(10)
+             .build())
+    epochs = int(get_env("EPOCHS_DIGITS", "20"))
+    return _train_and_eval("digits", model, train, val,
+                           epochs=epochs, lr=1e-3, target=0.95)
+
+
+def gate_mnist():
+    from dcnn_tpu.data import MNISTDataLoader
+    from dcnn_tpu.models import create_mnist_trainer
+
+    train_csv = get_env("MNIST_TRAIN_CSV", os.path.join(ROOT, "data/mnist/train.csv"))
+    test_csv = get_env("MNIST_TEST_CSV", os.path.join(ROOT, "data/mnist/test.csv"))
+    if not (os.path.isfile(train_csv) and os.path.isfile(test_csv)):
+        return {"gate": "mnist", "skipped":
+                f"dataset absent ({train_csv}); fetch with: "
+                "python -m dcnn_tpu.data.download --root data mnist"}
+    train = MNISTDataLoader(train_csv, data_format="NCHW", batch_size=128, seed=0)
+    val = MNISTDataLoader(test_csv, data_format="NCHW", batch_size=512,
+                          shuffle=False, drop_last=False)
+    train.load_data(); val.load_data()
+    model = create_mnist_trainer()
+    epochs = int(get_env("EPOCHS_MNIST", "12"))
+    return _train_and_eval("mnist", model, train, val,
+                           epochs=epochs, lr=1e-3, target=0.99)
+
+
+def gate_cifar10():
+    from dcnn_tpu.data import CIFAR10DataLoader
+    from dcnn_tpu.models import create_resnet9_cifar10
+
+    d = get_env("CIFAR10_DIR", os.path.join(ROOT, "data/cifar-10-batches-bin"))
+    train_files = [os.path.join(d, f"data_batch_{i}.bin") for i in range(1, 6)]
+    test_file = os.path.join(d, "test_batch.bin")
+    if not all(map(os.path.isfile, train_files + [test_file])):
+        return {"gate": "cifar10", "skipped":
+                f"dataset absent ({d}); fetch with: "
+                "python -m dcnn_tpu.data.download --root data cifar10"}
+    fmt = "NHWC" if jax.default_backend() == "tpu" else "NCHW"
+    train = CIFAR10DataLoader(train_files, data_format=fmt, batch_size=256, seed=0)
+    val = CIFAR10DataLoader(test_file, data_format=fmt, batch_size=512,
+                            shuffle=False, drop_last=False)
+    train.load_data(); val.load_data()
+    model = create_resnet9_cifar10(fmt)
+    epochs = int(get_env("EPOCHS_CIFAR10", "20"))
+    # top-1 recorded; 0.85 is the pass bar for a 20-epoch plain-Adam run
+    return _train_and_eval("cifar10", model, train, val,
+                           epochs=epochs, lr=1e-3, target=0.85)
+
+
+GATES = {"digits": gate_digits, "mnist": gate_mnist, "cifar10": gate_cifar10}
+
+
+def main():
+    cfg = setup("accuracy_gates")  # noqa: F841 - prints env/hardware banner
+    env_prec = os.environ.get("DCNN_PRECISION")
+    if env_prec:
+        # .env-file values land in os.environ after core.precision captured
+        # its import-time default, so apply them explicitly here
+        set_precision(env_prec)
+    else:
+        set_precision("bf16" if jax.default_backend() == "tpu" else "parity")
+    names = sys.argv[1:] or list(GATES)
+    unknown = [n for n in names if n not in GATES]
+    if unknown:
+        raise SystemExit(f"unknown gate(s) {unknown}; known: {sorted(GATES)}")
+    results = []
+    for name in names:
+        print(f"--- gate: {name} ---", flush=True)
+        res = GATES[name]()
+        print(json.dumps(res), flush=True)
+        results.append(res)
+
+    path = os.path.join(ROOT, "RESULTS.json")
+    existing = []
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = json.load(f)
+    by_gate = {r["gate"]: r for r in existing}
+    for r in results:
+        if "skipped" not in r or r["gate"] not in by_gate:
+            by_gate[r["gate"]] = r  # never clobber a real result with a skip
+    merged = list(by_gate.values())
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=1)
+
+    md = ["# Accuracy gates (real data)", "",
+          "Produced by `python examples/accuracy_gates.py`. SURVEY.md Stage 1",
+          "gate: MNIST >= 99% test accuracy (reference train.hpp:202-308).", "",
+          "| gate | model | epochs | val acc | target | passed | wall (s) | device | precision |",
+          "|---|---|---|---|---|---|---|---|---|"]
+    for r in merged:
+        if "skipped" in r:
+            md.append(f"| {r['gate']} | — | — | — | — | SKIPPED: {r['skipped']} | — | — | — |")
+        else:
+            md.append(
+                f"| {r['gate']} | {r['model']} | {r['epochs']} | {r['val_acc']} "
+                f"| {r['target']} | {'yes' if r['passed'] else 'NO'} "
+                f"| {r['wall_clock_s']} | {r['device']} | {r['precision']} |")
+    with open(os.path.join(ROOT, "RESULTS.md"), "w") as f:
+        f.write("\n".join(md) + "\n")
+    print(f"wrote RESULTS.md / RESULTS.json ({len(merged)} gates)")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,9 +18,9 @@ def main():
     cfg = setup("mnist_cnn_trainer")
 
     def real():
-        train = MNISTDataLoader(get_env("MNIST_TRAIN_CSV", "data/mnist/mnist_train.csv"),
+        train = MNISTDataLoader(get_env("MNIST_TRAIN_CSV", "data/mnist/train.csv"),
                                 batch_size=cfg.batch_size, seed=cfg.seed)
-        val = MNISTDataLoader(get_env("MNIST_TEST_CSV", "data/mnist/mnist_test.csv"),
+        val = MNISTDataLoader(get_env("MNIST_TEST_CSV", "data/mnist/test.csv"),
                               batch_size=cfg.batch_size, shuffle=False)
         train.load_data()
         val.load_data()

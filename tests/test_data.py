@@ -209,3 +209,31 @@ def test_synthetic_loader_trains():
                                            num_classes=4, batch_size=16)
     x, y = next(iter(loader))
     assert x.shape == (16, 1, 8, 8) and y.shape == (16, 4)
+
+
+def test_download_idx_to_csv_roundtrip(tmp_path):
+    """The downloader's IDX->CSV conversion must produce exactly what
+    MNISTDataLoader expects (reference Kaggle CSV schema: header +
+    label,784 pixel rows)."""
+    import struct
+
+    from dcnn_tpu.data.download import _idx_to_csv
+    from dcnn_tpu.data import MNISTDataLoader
+
+    rng = np.random.default_rng(0)
+    n, rows, cols = 5, 28, 28
+    imgs = rng.integers(0, 256, size=(n, rows, cols), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=n, dtype=np.uint8)
+    idx_imgs = struct.pack(">IIII", 2051, n, rows, cols) + imgs.tobytes()
+    idx_labels = struct.pack(">II", 2049, n) + labels.tobytes()
+
+    out_csv = str(tmp_path / "train.csv")
+    _idx_to_csv(idx_imgs, idx_labels, out_csv)
+
+    loader = MNISTDataLoader(out_csv, batch_size=5, shuffle=False)
+    loader.load_data()
+    x, y = next(iter(loader))
+    assert x.shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(
+        x.reshape(5, 28, 28), imgs.astype(np.float32) / 255.0, atol=1e-6)
+    np.testing.assert_array_equal(np.argmax(y, axis=1), labels)
