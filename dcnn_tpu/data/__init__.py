@@ -7,6 +7,7 @@ from .cifar import CIFAR10DataLoader, CIFAR100DataLoader
 from .tiny_imagenet import TinyImageNetDataLoader
 from .wifi import UJIWiFiDataLoader
 from .synthetic import SyntheticClassificationLoader
+from .prefetch import PrefetchLoader
 from .augment import (
     AugmentationBuilder, AugmentationStrategy,
     brightness, contrast, cutout, gaussian_noise, horizontal_flip,
@@ -17,6 +18,7 @@ __all__ = [
     "BaseDataLoader", "ArrayDataLoader", "one_hot",
     "MNISTDataLoader", "CIFAR10DataLoader", "CIFAR100DataLoader",
     "TinyImageNetDataLoader", "UJIWiFiDataLoader", "SyntheticClassificationLoader",
+    "PrefetchLoader",
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",

@@ -1,0 +1,145 @@
+"""PrefetchLoader + parallel-decode tests (SURVEY.md §7 hard part 5;
+reference input pipeline ``tiny_imagenet_data_loader.hpp:26-132``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dcnn_tpu.data import ArrayDataLoader, PrefetchLoader
+
+
+def _loader(n=32, batch=8):
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    y = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+    ld = ArrayDataLoader(x, y, batch_size=batch, shuffle=False)
+    ld.load_data()
+    return ld
+
+
+def test_prefetch_yields_same_batches():
+    inner = _loader()
+    pf = PrefetchLoader(_loader(), depth=2)
+    got = list(pf)
+    want = list(inner)
+    assert len(got) == len(want)
+    for (gx, gy), (wx, wy) in zip(got, want):
+        assert isinstance(gx, jax.Array)
+        np.testing.assert_array_equal(np.asarray(gx), wx)
+        np.testing.assert_array_equal(np.asarray(gy), wy)
+
+
+def test_prefetch_multiple_epochs_and_passthroughs():
+    pf = PrefetchLoader(_loader(), depth=2)
+    assert pf.batch_size == 8
+    assert pf.num_samples == 32
+    assert len(pf) == 4
+    pf.shuffle(1)  # must not raise
+    for _ in range(3):
+        assert len(list(pf)) == 4
+
+
+def test_prefetch_early_break_no_deadlock():
+    pf = PrefetchLoader(_loader(n=64, batch=8), depth=1)
+    for i, _ in enumerate(pf):
+        if i == 1:
+            break
+    # a second full iteration works (fresh producer thread per epoch)
+    assert len(list(pf)) == 8
+
+
+def test_prefetch_transform_hook():
+    pf = PrefetchLoader(_loader(), depth=2,
+                        transform=lambda x, y: (x * 2.0, y))
+    inner = list(_loader())
+    for (gx, _), (wx, _) in zip(pf, inner):
+        np.testing.assert_array_equal(np.asarray(gx), wx * 2.0)
+
+
+def test_prefetch_propagates_producer_error():
+    class Boom:
+        batch_size = 4
+        num_samples = 8
+
+        def __iter__(self):
+            yield (np.zeros((4, 2), np.float32), np.zeros((4, 2), np.float32))
+            raise RuntimeError("decode failed")
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(PrefetchLoader(Boom(), depth=2))
+
+
+def test_prefetch_chunked_staging():
+    pf = PrefetchLoader(_loader(n=64, batch=8), depth=2, stage_batches=3)
+    chunks = list(pf)
+    # 8 batches in chunks of 3 -> [3, 3, 2]
+    assert [c[0].shape[0] for c in chunks] == [3, 3, 2]
+    flat_x = np.concatenate([np.asarray(c[0]).reshape(-1, 4) for c in chunks])
+    want_x = np.concatenate([x for x, _ in _loader(n=64, batch=8)])
+    np.testing.assert_array_equal(flat_x, want_x)
+
+
+def test_prefetch_chunked_ragged_tail_batch():
+    # 20 samples, batch 8, drop_last=False -> batches of 8, 8, 4. The ragged
+    # 4-row batch can't stack with the 8-row ones: it must flush the full
+    # chunk and ship separately instead of crashing np.stack.
+    x = np.arange(20 * 4, dtype=np.float32).reshape(20, 4)
+    y = np.eye(2, dtype=np.float32)[np.arange(20) % 2]
+    ld = ArrayDataLoader(x, y, batch_size=8, shuffle=False, drop_last=False)
+    ld.load_data()
+    chunks = list(PrefetchLoader(ld, depth=2, stage_batches=3))
+    assert [(c[0].shape[0], c[0].shape[1]) for c in chunks] == [(2, 8), (1, 4)]
+    flat_x = np.concatenate([np.asarray(c[0]).reshape(-1, 4) for c in chunks])
+    np.testing.assert_array_equal(flat_x, x)
+
+
+def test_prefetch_early_break_stops_producer():
+    consumed = []
+
+    class Tracking:
+        batch_size = 4
+        num_samples = 400
+
+        def __iter__(self):
+            for i in range(100):
+                consumed.append(i)
+                yield (np.zeros((4, 2), np.float32),
+                       np.zeros((4, 2), np.float32))
+
+    for i, _ in enumerate(PrefetchLoader(Tracking(), depth=1)):
+        if i == 1:
+            break
+    # producer must stop near where the consumer broke (depth + a couple in
+    # flight), not run out the remaining ~98 batches
+    assert len(consumed) < 10
+
+
+def test_prefetch_sharded_placement():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    pf = PrefetchLoader(_loader(n=16, batch=8), depth=2, sharding=sharding)
+    x, y = next(iter(pf))
+    assert x.sharding.is_equivalent_to(sharding, x.ndim)
+    assert len(x.addressable_shards) == 4
+
+
+def test_parallel_decode_matches_serial(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from dcnn_tpu.data.tiny_imagenet import _decode_image, _decode_many
+
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(72):  # >64 so the thread-pool path runs, not the fallback
+        arr = rng.integers(0, 256, size=(16, 16, 3), dtype=np.uint8)
+        p = str(tmp_path / f"img{i}.png")  # png = lossless, exact compare
+        Image.fromarray(arr).save(p)
+        paths.append(p)
+    serial = [_decode_image(p) for p in paths]
+    parallel = _decode_many(paths)
+    for s, p in zip(serial, parallel):
+        np.testing.assert_array_equal(s, p)
