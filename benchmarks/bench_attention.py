@@ -1,0 +1,86 @@
+"""Attention microbenchmark: naive (materialising) vs blockwise (online
+softmax) vs Pallas flash kernel, forward and forward+backward, gated against
+the naive oracle.
+
+The reference has no attention (SURVEY.md §5.7) — this benches the
+long-context subsystem the TPU build adds (ops/attention.py) and provides the
+profiling evidence SURVEY Stage 4 prescribes for the Pallas path: flash must
+beat (or match) XLA's blockwise scan at these sizes to earn its place.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import numpy as np
+
+from common import Result, check_match, print_table, report, time_callable, tiny_mode
+
+TOL = 5e-3   # bf16-accumulator-free paths all keep fp32 stats; loose enough
+             # for bf16 MXU scores at S=2048
+
+
+def run() -> dict:
+    import importlib
+
+    import jax
+
+    # NB: plain ``import dcnn_tpu.ops.attention`` resolves to the re-exported
+    # *function* (the package __init__ rebinds the name); go via sys.modules
+    att = importlib.import_module("dcnn_tpu.ops.attention")
+
+    b, h, d = (2, 4, 64)
+    seqs = [256] if tiny_mode() else [1024, 4096]
+    steps = 3 if tiny_mode() else 10
+    results = []
+    rng = np.random.default_rng(0)
+    on_tpu = jax.default_backend() == "tpu"
+
+    impls = {
+        "naive": jax.jit(functools.partial(att.attention, causal=True)),
+        "blockwise": jax.jit(functools.partial(att.blockwise_attention, causal=True)),
+    }
+    if on_tpu:
+        impls["flash"] = jax.jit(functools.partial(att.flash_attention, causal=True))
+
+    for s in seqs:
+        q = rng.standard_normal((b, h, s, d), np.float32)
+        k = rng.standard_normal((b, h, s, d), np.float32)
+        v = rng.standard_normal((b, h, s, d), np.float32)
+        dq, dk, dv = map(jax.device_put, (q, k, v))
+        want = jax.device_get(impls["naive"](dq, dk, dv))
+        # causal attention FLOPs: ~0.5 * 4 * b*h*s^2*d (QK^T + PV, half masked)
+        flops = 2.0 * b * h * s * s * d
+        for name, fn in impls.items():
+            got = fn(dq, dk, dv)
+            ok, err = check_match(got, want, TOL)
+            dt = time_callable(lambda: fn(dq, dk, dv), steps=steps)
+            results.append(Result(f"attn_fwd_{name}_S{s}", dt,
+                                  flops / dt / 1e12, "TFLOP/s", ok, err))
+
+        # forward+backward (grad wrt q,k,v) — flash's VJP is a recompute
+        # through the blockwise path; this measures what training pays
+        grads = {
+            name: jax.jit(jax.grad(lambda a, b_, c, f=fn: f(a, b_, c).sum(),
+                                   argnums=(0, 1, 2)))
+            for name, fn in impls.items()
+        }
+        want_g = jax.device_get(grads["naive"](dq, dk, dv))
+        for name, gfn in grads.items():
+            got_g = gfn(dq, dk, dv)
+            oks, errs = zip(*(check_match(gg, wg, TOL)
+                              for gg, wg in zip(got_g, want_g)))
+            dt = time_callable(lambda: gfn(dq, dk, dv), steps=steps)
+            results.append(Result(f"attn_bwd_{name}_S{s}", dt,
+                                  3.5 * flops / dt / 1e12, "TFLOP/s",
+                                  all(oks), max(errs)))
+    return report("attention", results,
+                  meta={"batch": b, "heads": h, "head_dim": d,
+                        "flash_available": on_tpu})
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

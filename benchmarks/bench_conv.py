@@ -1,0 +1,111 @@
+"""Conv2D microbenchmark: ResNet-18/Tiny-ImageNet layer shapes, forward plus
+the three backward kernels, each with a correctness gate.
+
+Reference equivalent: the conv hot path the reference hand-optimizes
+(``include/nn/layers_impl/cpu/conv2d_ops.hpp:8-29`` im2col→GEMM,
+``src/nn/layers_impl/cuda/cudnn_conv2d_ops.cu``) and its benchmark-with-gate
+pattern (``benchmarks/gemm_benchmark.cpp:21-34``). Forward is gated against
+fp64 PyTorch (the same oracle the unit tests use); the explicit
+weight/input-grad kernels are gated against jax autodiff of the forward.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import numpy as np
+
+from common import Result, check_match, print_table, report, time_callable, tiny_mode
+
+# (cin, cout, hw, kernel, stride, pad) — ResNet-18 tiny-imagenet trunk shapes
+# (models/zoo.py create_resnet18_tiny_imagenet)
+SHAPES = [
+    (3, 64, 64, 3, 1, 1),      # stem
+    (64, 64, 64, 3, 1, 1),     # stage 1 block conv
+    (64, 128, 32, 3, 2, 1),    # stage 2 downsample
+    (128, 128, 32, 3, 1, 1),
+    (256, 256, 16, 3, 1, 1),
+    (512, 512, 8, 3, 1, 1),    # stage 4 block conv
+]
+TOLS = {"parity": 5e-5, "fast": 3e-2}
+
+
+def _torch_conv_fp64(x, w, stride, pad):
+    import torch
+
+    with torch.no_grad():
+        out = torch.nn.functional.conv2d(
+            torch.from_numpy(x).double(), torch.from_numpy(w).double(),
+            stride=stride, padding=pad)
+    return out.numpy()
+
+
+def run() -> dict:
+    import jax
+
+    from dcnn_tpu.core.precision import set_precision
+    from dcnn_tpu.ops import conv as conv_ops
+
+    batch = 16 if tiny_mode() else 128
+    shapes = SHAPES[:3] if tiny_mode() else SHAPES
+    steps = 5 if tiny_mode() else 10
+    results = []
+    rng = np.random.default_rng(0)
+    for mode in ("parity", "fast"):
+        set_precision(mode)
+        fwd = jax.jit(functools.partial(conv_ops.conv2d, data_format="NCHW"),
+                      static_argnames=("stride", "padding"))
+        wgrad = jax.jit(functools.partial(conv_ops.conv2d_weight_grad,
+                                          data_format="NCHW"),
+                        static_argnames=("kernel_hw", "stride", "padding"))
+        igrad = jax.jit(functools.partial(conv_ops.conv2d_input_grad,
+                                          data_format="NCHW"),
+                        static_argnames=("input_shape", "stride", "padding"))
+        for cin, cout, hw, k, s, p in shapes:
+            x = rng.standard_normal((batch, cin, hw, hw), np.float32)
+            w = rng.standard_normal((cout, cin, k, k), np.float32) / np.sqrt(cin * k * k)
+            dx, dw = jax.device_put(x), jax.device_put(w)
+            tag = f"{cin}x{hw}x{hw}->{cout}_s{s}_{mode}"
+
+            got = fwd(dx, dw, stride=s, padding=p)
+            ok, err = check_match(got, _torch_conv_fp64(x, w, s, p), TOLS[mode])
+            oh = got.shape[2]
+            flops = 2.0 * batch * cout * cin * k * k * oh * oh
+            dt = time_callable(lambda: fwd(dx, dw, stride=s, padding=p), steps=steps)
+            results.append(Result(f"conv_fwd_{tag}", dt, flops / dt / 1e12,
+                                  "TFLOP/s", ok, err))
+
+            g = rng.standard_normal(got.shape, np.float32)
+            dg = jax.device_put(g)
+            # autodiff oracle for the explicit backward kernels (same-device,
+            # parity precision) — these are distinct code paths in ops/conv.py
+            set_precision("parity")
+            _, vjp = jax.vjp(lambda xx, ww: conv_ops.conv2d(
+                xx, ww, stride=s, padding=p, data_format="NCHW"), dx, dw)
+            want_ig, want_wg = jax.device_get(vjp(dg))
+            set_precision(mode)
+
+            got_wg = wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p)
+            ok, err = check_match(got_wg, want_wg, TOLS[mode])
+            dt = time_callable(
+                lambda: wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p),
+                steps=steps)
+            results.append(Result(f"conv_wgrad_{tag}", dt, flops / dt / 1e12,
+                                  "TFLOP/s", ok, err))
+
+            got_ig = igrad(dw, dg, input_shape=x.shape, stride=s, padding=p)
+            ok, err = check_match(got_ig, want_ig, TOLS[mode])
+            dt = time_callable(
+                lambda: igrad(dw, dg, input_shape=x.shape, stride=s, padding=p),
+                steps=steps)
+            results.append(Result(f"conv_igrad_{tag}", dt, flops / dt / 1e12,
+                                  "TFLOP/s", ok, err))
+    set_precision("parity")
+    return report("conv", results, meta={"batch": batch})
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

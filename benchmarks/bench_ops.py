@@ -1,0 +1,83 @@
+"""Tensor/elementwise-op throughput benchmark (effective HBM GB/s).
+
+Reference equivalent: ``/root/reference/benchmarks/tensor_ops_benchmark.cpp``
+(739 LoC of per-op timing sections). Each op is gated against numpy fp64 and
+rated in effective memory bandwidth (bytes read + written / second) — the
+meaningful roofline axis for elementwise work on TPU, where the VPU is
+bandwidth-bound.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import Result, check_match, print_table, report, time_callable, tiny_mode
+
+TOL = 1e-5
+
+
+def run() -> dict:
+    import jax
+
+    from dcnn_tpu.ops import elementwise as ew
+
+    n = (1 << 20) if tiny_mode() else (1 << 26)   # 4 MiB / 256 MiB fp32
+    steps = 5 if tiny_mode() else 10
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(n).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    c = rng.standard_normal(n).astype(np.float32)
+    da, db, dc = map(jax.device_put, (a, b, c))
+    a64, b64, c64 = a.astype(np.float64), b.astype(np.float64), c.astype(np.float64)
+    itemsize = 4
+
+    # (name, jitted fn, host oracle, arrays touched r+w)
+    cases = [
+        ("add", jax.jit(ew.add), lambda: a64 + b64, 3),
+        ("fmadd", jax.jit(ew.fmadd), lambda: a64 * b64 + c64, 4),
+        ("axpy", jax.jit(lambda x, y: ew.axpy(2.5, x, y)),
+         lambda: 2.5 * a64 + b64, 3),
+        ("sqrt_abs", jax.jit(lambda x: ew.sqrt(ew.abs(x))),
+         lambda: np.sqrt(np.abs(a64)), 2),
+        ("clamp", jax.jit(lambda x: ew.clamp(x, -1.0, 1.0)),
+         lambda: np.clip(a64, -1.0, 1.0), 2),
+        ("sum", jax.jit(ew.sum), lambda: a64.sum(), 1),
+        ("dot_product", jax.jit(ew.dot_product), lambda: a64 @ b64, 2),
+    ]
+    results = []
+    for name, fn, oracle, n_arrays in cases:
+        args = {"add": (da, db), "fmadd": (da, db, dc), "axpy": (da, db),
+                "dot_product": (da, db)}.get(name, (da,))
+        got = fn(*args)
+        # reductions over 2^26 elements accumulate ~n*eps error; scale tol
+        tol = TOL * (np.sqrt(n) / 100 if n_arrays < 3 and np.ndim(got) == 0 else 1.0)
+        ok, err = check_match(got, oracle(), tol)
+        dt = time_callable(lambda: fn(*args), steps=steps)
+        gbps = n_arrays * n * itemsize / dt / 1e9
+        results.append(Result(f"ew_{name}", dt, gbps, "GB/s", ok, err))
+
+    # layout moves (the reference's nchw<->cnhw/nhwc transposes — on TPU
+    # these are real HBM-bound relayouts worth tracking)
+    shape = (8, 64, 32, 32) if tiny_mode() else (64, 128, 64, 64)
+    x4 = rng.standard_normal(shape).astype(np.float32)
+    dx4 = jax.device_put(x4)
+    for name, fn, oracle in [
+        ("nchw_to_nhwc", jax.jit(ew.nchw_to_nhwc),
+         lambda: x4.transpose(0, 2, 3, 1)),
+        ("nchw_to_cnhw", jax.jit(ew.nchw_to_cnhw),
+         lambda: x4.transpose(1, 0, 2, 3)),
+    ]:
+        got = fn(dx4)
+        ok, err = check_match(got, oracle(), TOL)
+        dt = time_callable(lambda: fn(dx4), steps=steps)
+        gbps = 2 * x4.nbytes / dt / 1e9
+        results.append(Result(f"layout_{name}", dt, gbps, "GB/s", ok, err))
+    return report("tensor_ops", results, meta={"elements": n})
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

@@ -1,0 +1,106 @@
+"""Serialization + compression throughput benchmark.
+
+Reference equivalent: ``/root/reference/benchmarks/serialization_benchmark.cpp``
+and ``compression_benchmark.cpp`` — how fast can an activation/parameter
+payload be framed, compressed, and recovered. Every codec row is gated on an
+exact round-trip (compress→decompress→bitwise compare), and the checkpoint
+rows gate on a full save→load→tree-equality cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from common import Result, print_table, report, tiny_mode
+
+
+def _time_host(fn, reps: int = 5):
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def run() -> dict:
+    from dcnn_tpu.utils.compression import (MetaCompressor, RawCompressor,
+                                            ZlibCompressor)
+
+    results = []
+    mc = MetaCompressor()
+    mb = 4 if tiny_mode() else 64
+    rng = np.random.default_rng(0)
+    # two payload classes the pipeline actually ships: near-incompressible
+    # activations, and structured (quantized-ish) gradients with many repeats
+    payloads = {
+        "activations": rng.standard_normal(mb * 1024 * 256).astype(np.float32),
+        "sparse_grads": (rng.standard_normal(mb * 1024 * 256) *
+                         (rng.random(mb * 1024 * 256) < 0.05)).astype(np.float32),
+    }
+    codecs = {"raw": RawCompressor(), "zlib1": ZlibCompressor(level=1)}
+    if 2 in mc.codecs:
+        codecs["zstd"] = mc.codecs[2]
+
+    for pname, arr in payloads.items():
+        nbytes = arr.nbytes
+        for cname, codec in codecs.items():
+            dt_c, blob = _time_host(lambda: mc.compress_array(arr, codec))
+            dt_d, back = _time_host(lambda: mc.decompress_array(blob))
+            ok = (back.dtype == arr.dtype and back.shape == arr.shape
+                  and np.array_equal(back, arr))
+            results.append(Result(
+                f"compress_{pname}_{cname}", dt_c, nbytes / dt_c / 1e9, "GB/s",
+                ok, 0.0 if ok else float("inf"),
+                extra={"ratio": round(nbytes / len(blob), 3)}))
+            results.append(Result(
+                f"decompress_{pname}_{cname}", dt_d, nbytes / dt_d / 1e9,
+                "GB/s", ok, 0.0 if ok else float("inf")))
+
+    # checkpoint save/load round-trip (train/checkpoint.py msgpack+JSON path)
+    import jax
+
+    from dcnn_tpu.models.zoo import create_resnet9_cifar10, create_mnist_trainer
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+    from dcnn_tpu.train.trainer import create_train_state
+
+    model = create_mnist_trainer() if tiny_mode() else create_resnet9_cifar10()
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(ts.params))
+    tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        path = os.path.join(tmp, "ckpt")
+        dt_s, _ = _time_host(lambda: save_checkpoint(
+            path, model, ts.params, ts.state, ts.opt_state, opt), reps=3)
+        dt_l, loaded = _time_host(lambda: load_checkpoint(path), reps=3)
+        _, lp, _, lopt, _, _ = loaded
+        ok = all(np.array_equal(np.asarray(a), np.asarray(b))
+                 for a, b in zip(jax.tree_util.tree_leaves(ts.params),
+                                 jax.tree_util.tree_leaves(lp)))
+        ok = ok and lopt is not None
+        results.append(Result("checkpoint_save", dt_s,
+                              param_bytes / dt_s / 1e9, "GB/s(params)", ok,
+                              0.0 if ok else float("inf"),
+                              extra={"param_mb": round(param_bytes / 2**20, 1)}))
+        results.append(Result("checkpoint_load", dt_l,
+                              param_bytes / dt_l / 1e9, "GB/s(params)", ok,
+                              0.0 if ok else float("inf")))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return report("serialization", results)
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

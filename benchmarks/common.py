@@ -1,0 +1,126 @@
+"""Shared microbenchmark harness.
+
+Reference equivalent: the timing + correctness-gate pattern of
+``/root/reference/benchmarks/gemm_benchmark.cpp:16-50`` (every timed kernel
+is first checked against a trusted reference implementation — a benchmark
+that produces wrong numbers fast is a bug, not a result) and the
+section-per-op layout of ``tensor_ops_benchmark.cpp``.
+
+TPU specifics: all timing is fenced with ``core.fence.hard_fence`` (a real
+device->host transfer — ``block_until_ready`` can return early on tunnelled
+PJRT backends), jitted callables are warmed before timing, and throughput is
+best-of-reps (steady-state capability, robust to dispatch jitter).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dcnn_tpu.core.fence import hard_fence
+
+
+@dataclass
+class Result:
+    """One benchmark row: name, timing, derived rate, correctness verdict."""
+
+    name: str
+    seconds: float
+    rate: Optional[float] = None        # work / second (unit below)
+    unit: Optional[str] = None
+    correct: Optional[bool] = None      # None = no gate for this row
+    max_err: Optional[float] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {"name": self.name, "seconds": round(self.seconds, 6)}
+        if self.rate is not None:
+            out["rate"] = round(self.rate, 3)
+            out["unit"] = self.unit
+        if self.correct is not None:
+            # np.array_equal & co. return np.bool (numpy 2), which the json
+            # encoder rejects — coerce at the boundary
+            out["correct"] = bool(self.correct)
+            out["max_err"] = (None if self.max_err is None
+                              else float(f"{self.max_err:.3e}"))
+        out.update(self.extra)
+        return out
+
+
+def check_match(got, want, tol: float, name: str = "") -> tuple:
+    """Correctness gate (reference ``gemm_benchmark.cpp:21-34`` check_match):
+    elementwise compare against the trusted reference; returns
+    (passed, max_abs_err). Relative tolerance scaled by the magnitude of
+    ``want`` so fp32-vs-bf16 comparisons use a meaningful threshold."""
+    got = np.asarray(got, np.float64)
+    want = np.asarray(want, np.float64)
+    if got.shape != want.shape:
+        return False, float("inf")
+    denom = max(1.0, float(np.max(np.abs(want))))
+    err = float(np.max(np.abs(got - want))) / denom
+    return bool(err <= tol), err
+
+
+def time_callable(fn: Callable[[], Any], steps: int = 10, reps: int = 3,
+                  warmup: int = 2) -> float:
+    """Best-of-reps seconds for ``steps`` dispatches of ``fn``.
+
+    ``fn`` must return (a pytree containing) the device array(s) produced, so
+    the fence can await them. Warmup covers compile + cache effects."""
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    hard_fence(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn()
+        hard_fence(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+def report(section: str, results: List[Result], out_path: Optional[str] = None,
+           meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble + optionally persist one section's machine-readable report."""
+    import jax
+
+    doc = {
+        "section": section,
+        "device": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "results": [r.to_json() for r in results],
+        "all_correct": bool(all(r.correct for r in results
+                                if r.correct is not None)),
+    }
+    if meta:
+        doc["meta"] = meta
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc
+
+
+def print_table(doc: Dict[str, Any]) -> None:
+    print(f"== {doc['section']} [{doc['device']}] ==")
+    for r in doc["results"]:
+        gate = ("" if "correct" not in r
+                else ("  OK" if r["correct"] else "  **MISMATCH**"))
+        rate = (f"  {r['rate']:>12.3f} {r['unit']}" if "rate" in r else "")
+        print(f"  {r['name']:<42s} {r['seconds'] * 1e3:>9.3f} ms{rate}{gate}")
+
+
+def tiny_mode() -> bool:
+    """BENCH_TINY=1 shrinks problem sizes so the suite doubles as a CI test
+    (the reference runs its benchmarks as manual executables; here the same
+    code is importable and pytest-runnable)."""
+    return os.environ.get("BENCH_TINY", "0") == "1"

@@ -1,0 +1,58 @@
+"""Benchmark-suite smoke tests (VERDICT r1 #7; reference
+``benchmarks/gemm_benchmark.cpp:16-50`` correctness-gate pattern)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+
+def test_check_match_gate():
+    from common import check_match
+
+    ok, err = check_match(np.ones(4), np.ones(4) + 1e-7, 1e-5)
+    assert ok and isinstance(ok, bool) and err < 1e-5
+    ok, _ = check_match(np.ones(4), np.ones(4) + 1.0, 1e-5)
+    assert not ok
+    ok, err = check_match(np.ones(4), np.ones(5), 1e-5)
+    assert not ok and err == float("inf")
+
+
+def test_serialization_section_runs_and_gates():
+    import bench_serialization
+
+    os.environ["BENCH_TINY"] = "1"
+    try:
+        doc = bench_serialization.run()
+    finally:
+        os.environ.pop("BENCH_TINY", None)
+    assert doc["all_correct"] is True
+    names = {r["name"] for r in doc["results"]}
+    assert {"checkpoint_save", "checkpoint_load"} <= names
+    assert any(n.startswith("compress_") for n in names)
+    # machine-readable: every row JSON-serializable
+    import json
+
+    json.dumps(doc)
+
+
+@pytest.mark.slow
+def test_run_all_tiny_subprocess():
+    """Full suite in tiny mode as one command (the 'one command emits a
+    machine-readable benchmark report' done-criterion)."""
+    env = dict(os.environ, BENCH_TINY="1", DCNN_PLATFORM="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "run_all.py"),
+         "--only", "bench_gemm", "--out", "/tmp/bench_results_test.json"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json
+
+    with open("/tmp/bench_results_test.json") as f:
+        doc = json.load(f)
+    assert doc["all_correct"] is True
