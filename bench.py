@@ -153,26 +153,48 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
     dt, ts = _measure(step, ts, x, y, key, dispatches, reps)
     img_per_sec = batch * steps / dt
 
-    pipeline_img_per_sec = None
-    if pipeline and chunk == 1 and os.environ.get("BENCH_PIPELINE", "1") != "0":
-        # Input-pipeline-included throughput: host loader -> PrefetchLoader
-        # with chunked staging (K batches stacked per H2D transfer; on a
-        # tunnelled TPU host an H2D issued behind a busy dispatch queue pays
-        # a full queue drain, so per-batch puts crater feed rate) -> in-jit
-        # K-step train loop (train.make_multi_step, one dispatch per chunk).
-        # Compares feed rate vs step rate (VERDICT r1 #6).
+    pipeline_img_per_sec = h2d_gbps = None
+    if pipeline and os.environ.get("BENCH_PIPELINE", "1") != "0":
+        # Input-pipeline-included throughput: host loader (uint8 images +
+        # int labels — the idiomatic TPU feed payload, 4x fewer H2D bytes
+        # than fp32) -> PrefetchLoader with chunked staging (K batches
+        # stacked per transfer) + on-device decode (cast/scale/one-hot via
+        # device_transform) -> in-jit K-step train loop (train.make_multi_step,
+        # one dispatch per chunk). Compares feed rate vs step rate
+        # (VERDICT r1 #6). NB: on this tunnelled TPU host H2D rides the
+        # tunnel (~0.1 GB/s measured, vs >10 GB/s for a directly-attached
+        # host) — h2d_gbps is reported alongside so feed_efficiency can be
+        # read in context.
+        import numpy as np
+
         from dcnn_tpu.core.fence import hard_fence as _hf
-        from dcnn_tpu.data import PrefetchLoader, SyntheticClassificationLoader
+        from dcnn_tpu.core.precision import get_compute_dtype
+        from dcnn_tpu.data import ArrayDataLoader, PrefetchLoader
         from dcnn_tpu.train import make_multi_step
 
         stage = int(os.environ.get("BENCH_STAGE", "10"))
         n_chunks = int(os.environ.get("BENCH_PIPELINE_CHUNKS", "5"))
-        img_shape = shape[1:]
-        loader = SyntheticClassificationLoader(
-            num_samples=batch * stage * n_chunks, image_shape=img_shape,
-            num_classes=200, batch_size=batch, shuffle=False)
+        n_samples = batch * stage * n_chunks
+        rng_np = np.random.default_rng(0)
+        x_u8 = rng_np.integers(0, 256, size=(n_samples, *shape[1:]),
+                               dtype=np.uint8)
+        labels = rng_np.integers(0, 200, size=n_samples).astype(np.int32)
+        loader = ArrayDataLoader(x_u8, labels, batch_size=batch, shuffle=False)
         loader.load_data()
-        pf = PrefetchLoader(loader, depth=2, stage_batches=stage)
+
+        # raw H2D bandwidth for context (one 64 MiB buffer, hard-fenced)
+        probe = rng_np.integers(0, 256, size=(64 << 20,), dtype=np.uint8)
+        _hf(jax.device_put(probe[: 1 << 20]))  # warm the transfer path
+        t0 = time.perf_counter()
+        _hf(jax.device_put(probe))
+        h2d_gbps = probe.nbytes / (time.perf_counter() - t0) / 1e9
+
+        cdt = get_compute_dtype() or jnp.float32
+        decode = jax.jit(lambda xu, yi: (
+            xu.astype(cdt) / np.asarray(255.0, cdt),
+            jax.nn.one_hot(yi, 200, dtype=jnp.float32)))
+        pf = PrefetchLoader(loader, depth=2, stage_batches=stage,
+                            device_transform=decode)
         multi = make_multi_step(model, softmax_cross_entropy, opt)
         ts2 = create_train_state(model, opt, key)
         # untimed epoch: compiles the multi-step executable + warms the
@@ -201,7 +223,7 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
     # the reference's partitioner uses the same estimator family)
     fwd_flops_per_img = model.forward_complexity()
     train_flops = 3.0 * fwd_flops_per_img * img_per_sec
-    return img_per_sec, dt / steps, train_flops / 1e12, pipeline_img_per_sec
+    return img_per_sec, dt / steps, train_flops / 1e12, pipeline_img_per_sec, h2d_gbps
 
 
 def main() -> None:
@@ -216,9 +238,12 @@ def main() -> None:
     reps = int(os.environ.get("BENCH_REPS", "3"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
     profile_dir = os.environ.get("BENCH_PROFILE")
-    chunk = int(os.environ.get("BENCH_CHUNK", "1"))
+    # default 10 steps per dispatch: measured 21.2k vs 18.0k img/s at chunk=1
+    # on the tunnelled v5e host — per-dispatch launch latency rides the
+    # tunnel, and the in-jit multi-step loop amortizes it
+    chunk = int(os.environ.get("BENCH_CHUNK", "10"))
 
-    img_per_sec, sec_per_step, tflops, pipeline_ips = run_config(
+    img_per_sec, sec_per_step, tflops, pipeline_ips, h2d_gbps = run_config(
         batch, steps, reps, data_format, profile_dir, chunk=chunk,
         pipeline=True)
 
@@ -257,6 +282,7 @@ def main() -> None:
                                  if pipeline_ips is not None else None),
         "feed_efficiency": (round(pipeline_ips / img_per_sec, 3)
                             if pipeline_ips is not None else None),
+        "h2d_gbps": round(h2d_gbps, 3) if h2d_gbps is not None else None,
     }
 
     if os.environ.get("BENCH_MATRIX"):
@@ -269,7 +295,7 @@ def main() -> None:
                 if f"{fmt}_{prec}" in matrix:
                     continue
                 set_precision(prec)  # read at trace time; run_config re-jits
-                ips, _, tf, _ = run_config(batch, max(steps // 2, 5), 2, fmt)
+                ips, _, tf, _, _ = run_config(batch, max(steps // 2, 5), 2, fmt)
                 matrix[f"{fmt}_{prec}"] = {
                     "img_per_sec": round(ips, 1), "tflops": round(tf, 2)}
         set_precision(precision)

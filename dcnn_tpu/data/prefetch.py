@@ -44,6 +44,12 @@ class PrefetchLoader:
     data-parallel mesh; default placement is the default device.
     ``transform(x, y) -> (x, y)`` runs on the producer thread (host-side
     augmentation hook mirroring the reference's per-batch augmentation).
+    ``device_transform(x, y) -> (x, y)`` runs on the producer thread AFTER
+    ``device_put`` — a (jitted) on-device function dispatched asynchronously,
+    e.g. uint8→bf16 decode + normalize + one-hot. Shipping uint8 and casting
+    on device cuts H2D bytes 4× vs fp32, which is the idiomatic TPU input
+    recipe (and decisive on hosts where H2D bandwidth, not decode, bounds
+    feed rate).
     ``stage_batches=K`` stacks K batches per transfer, yielding [K, B, ...]
     device arrays for ``train.make_multi_step`` — the remote-TPU-friendly
     feeding mode (one H2D sync per K steps). With a ``sharding``, note the
@@ -54,6 +60,7 @@ class PrefetchLoader:
     def __init__(self, inner, depth: int = 2,
                  sharding: Optional[Any] = None,
                  transform: Optional[Callable] = None,
+                 device_transform: Optional[Callable] = None,
                  stage_batches: int = 1):
         if depth < 1:
             raise ValueError("depth must be >= 1")
@@ -63,6 +70,7 @@ class PrefetchLoader:
         self.depth = depth
         self.sharding = sharding
         self.transform = transform
+        self.device_transform = device_transform
         self.stage_batches = stage_batches
 
     # passthroughs so PrefetchLoader is a drop-in for Trainer.fit
@@ -83,9 +91,13 @@ class PrefetchLoader:
 
     def _device_put(self, x, y):
         if self.sharding is not None:
-            return (jax.device_put(x, self.sharding),
-                    jax.device_put(y, self.sharding))
-        return jax.device_put(x), jax.device_put(y)
+            dx, dy = (jax.device_put(x, self.sharding),
+                      jax.device_put(y, self.sharding))
+        else:
+            dx, dy = jax.device_put(x), jax.device_put(y)
+        if self.device_transform is not None:
+            dx, dy = self.device_transform(dx, dy)
+        return dx, dy
 
     def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
         q: queue.Queue = queue.Queue(maxsize=self.depth)

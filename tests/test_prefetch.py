@@ -56,6 +56,21 @@ def test_prefetch_transform_hook():
         np.testing.assert_array_equal(np.asarray(gx), wx * 2.0)
 
 
+def test_prefetch_device_transform_uint8_feed():
+    # the idiomatic TPU feed: ship uint8 + int labels, decode on device
+    x = np.arange(16 * 4, dtype=np.uint8).reshape(16, 4)
+    y = (np.arange(16) % 3).astype(np.int32)
+    ld = ArrayDataLoader(x, y, batch_size=8, shuffle=False)
+    ld.load_data()
+    decode = jax.jit(lambda xu, yi: (xu.astype(jnp.float32) / 255.0,
+                                     jax.nn.one_hot(yi, 3)))
+    pf = PrefetchLoader(ld, depth=2, device_transform=decode)
+    gx, gy = next(iter(pf))
+    assert gx.dtype == jnp.float32 and gy.shape == (8, 3)
+    np.testing.assert_allclose(np.asarray(gx), x[:8] / 255.0, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(gy).argmax(-1), y[:8])
+
+
 def test_prefetch_propagates_producer_error():
     class Boom:
         batch_size = 4
