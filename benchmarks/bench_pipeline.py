@@ -75,7 +75,8 @@ def run() -> dict:
     for schedule in ("sync", "semi_async"):
         coord = InProcessPipelineCoordinator(
             build(), SGD(1e-2), "softmax_crossentropy",
-            num_stages=num_stages, num_microbatches=num_micro)
+            num_stages=num_stages, num_microbatches=num_micro,
+            track_load=False)  # zero telemetry fences in the timed path
         coord.deploy_stages(key)
         fn = (coord.train_batch_sync if schedule == "sync"
               else coord.train_batch_semi_async)

@@ -25,7 +25,8 @@ TPU-native design:
 from .partitioner import FlopBalancedPartitioner, NaivePartitioner, Partitioner
 from .data_parallel import make_data_parallel_train_step, shard_batch, replicate
 from .pipeline import (
-    InProcessPipelineCoordinator, PipelineStage, train_pipeline_batch_sync,
+    InProcessPipelineCoordinator, PipelineError, PipelineStage,
+    train_pipeline_batch_sync,
 )
 from .compiled_pipeline import (
     HeteroCompiledPipeline, SequentialStageStack,
@@ -43,7 +44,8 @@ from .worker import StageWorker, run_worker
 __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
     "make_data_parallel_train_step", "shard_batch", "replicate",
-    "PipelineStage", "InProcessPipelineCoordinator", "train_pipeline_batch_sync",
+    "PipelineStage", "InProcessPipelineCoordinator", "PipelineError",
+    "train_pipeline_batch_sync",
     "HeteroCompiledPipeline", "SequentialStageStack",
     "make_compiled_pipeline_forward",
     "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",

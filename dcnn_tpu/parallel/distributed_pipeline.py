@@ -60,7 +60,8 @@ class DistributedPipelineCoordinator:
     def __init__(self, model: Sequential, optimizer: Optimizer, loss: str,
                  workers: Sequence[str],
                  partitioner: Optional[Partitioner] = None,
-                 num_microbatches: int = 4, track_load: bool = False,
+                 num_microbatches: int = 4,
+                 track_load: "bool | str" = "sample",
                  compress: bool = False, timeout: float = 120.0):
         self.model = model
         self.optimizer = optimizer

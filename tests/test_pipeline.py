@@ -161,5 +161,6 @@ def test_microbatch_cache_isolation():
     g = jnp.ones_like(outs[2])
     stage.backward(2, g)
     assert 2 not in stage._cache and len(stage._cache) == 3
-    with pytest.raises(KeyError):
+    from dcnn_tpu.parallel import PipelineError
+    with pytest.raises(PipelineError):
         stage.backward(2, g)

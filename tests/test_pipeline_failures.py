@@ -1,0 +1,146 @@
+"""Pipeline failure-path + load-tracking tests (VERDICT r1 #8; reference
+``coordinator.hpp:253-265`` timeout joins, ``pipeline_stage.hpp:199-229``
+load tracking, ``:276-282`` error reports)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.parallel import InProcessPipelineCoordinator, PipelineError
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _model():
+    # batchnorm in BOTH halves of the 2-stage split (8 layers -> 4+4) so
+    # abort must roll back mutated layer state (BN running stats) on every
+    # stage, not just caches/grads
+    return (SequentialBuilder("fail_model")
+            .input((1, 8, 8))
+            .conv2d(4, 3, 1, 1).batchnorm().activation("relu")
+            .conv2d(4, 3, 1, 1).batchnorm().activation("relu")
+            .flatten()
+            .dense(10)
+            .build())
+
+
+def _coord(**kw):
+    coord = InProcessPipelineCoordinator(
+        _model(), SGD(0.05), "softmax_crossentropy",
+        num_stages=2, num_microbatches=2, **kw)
+    coord.deploy_stages(KEY)
+    return coord
+
+
+def _batch(n=8):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 1, 8, 8)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    return x, y
+
+
+@pytest.mark.parametrize("schedule", ["sync", "semi_async"])
+def test_stage_failure_aborts_and_recovers(schedule):
+    """A stage raising mid-schedule must (a) surface as PipelineError with
+    stage context, (b) leave no stale microbatch caches or partial grads,
+    (c) let the next batch train identically to a never-failed coordinator."""
+    coord = _coord()
+    ref = _coord()
+    x, y = _batch()
+    fn = coord.train_batch_sync if schedule == "sync" else coord.train_batch_semi_async
+    ref_fn = ref.train_batch_sync if schedule == "sync" else ref.train_batch_semi_async
+
+    # break stage 1's backward for one batch
+    victim = coord.stages[1]
+    orig_bwd = victim._bwd
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    victim._bwd = boom
+    with pytest.raises(PipelineError) as ei:
+        fn(x, y, lr=0.05)
+    assert ei.value.stage_id == 1
+    assert ei.value.phase == "backward"
+    victim._bwd = orig_bwd
+
+    # consistent idle state: no cached microbatches, no partial grads, and
+    # layer state (BN running stats) rolled back to batch start
+    for s, r in zip(coord.stages, ref.stages):
+        assert s._cache == {}
+        assert s._grad_count == 0
+        for g in jax.tree_util.tree_leaves(s._grad_acc):
+            np.testing.assert_array_equal(np.asarray(g), 0.0)
+        for a, b in zip(jax.tree_util.tree_leaves(s.state),
+                        jax.tree_util.tree_leaves(r.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the failed batch must not have perturbed training state
+    loss_after, _ = fn(x, y, lr=0.05)
+    loss_ref, _ = ref_fn(x, y, lr=0.05)
+    np.testing.assert_allclose(loss_after, loss_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_failure_context():
+    coord = _coord()
+    x, y = _batch()
+    coord.stages[0]._fwd = lambda *a, **k: (_ for _ in ()).throw(
+        ValueError("bad input"))
+    with pytest.raises(PipelineError) as ei:
+        coord.train_batch_sync(x, y, lr=0.05)
+    assert ei.value.stage_id == 0 and ei.value.phase == "forward"
+
+
+def test_unknown_microbatch_is_pipeline_error():
+    coord = _coord()
+    with pytest.raises(PipelineError) as ei:
+        coord.stages[0].backward(99, jnp.zeros((4, 10)))
+    assert ei.value.mb_id == 99
+
+
+def test_join_and_timeout(monkeypatch):
+    coord = _coord()
+    x, y = _batch()
+    coord.train_batch_sync(x, y, lr=0.05)
+    assert coord.join() is True
+    assert coord.join(timeout=30.0) is True
+
+    # force expiry: make the fence hang
+    import dcnn_tpu.parallel.pipeline as pl
+
+    def slow_fence(tree):
+        import time
+        time.sleep(1.0)
+
+    monkeypatch.setattr(pl, "hard_fence", slow_fence)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert coord.join(timeout=0.05) is False
+    assert any("timed out" in str(x.message) for x in w)
+
+
+def test_sampled_load_tracking():
+    coord = _coord(track_load="sample")
+    x, y = _batch(32)
+    # SAMPLE_EVERY=8: run enough microbatches that each stage samples >=2
+    for _ in range(10):
+        coord.train_batch_sync(x, y, lr=0.05)
+    reports = coord.collect_load_reports()
+    assert len(reports) == 2
+    for r in reports:
+        assert r["forward_count"] >= 2
+        assert r["backward_count"] >= 2
+        assert r["avg_forward_ms"] > 0.0
+        assert r["avg_backward_ms"] > 0.0
+    # sampling must not fence every call
+    assert coord.stages[0]._fwd_calls > coord.stages[0].load.forward_count
+
+
+def test_track_load_validation():
+    with pytest.raises(ValueError):
+        _coord(track_load="always")
