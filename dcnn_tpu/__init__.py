@@ -25,6 +25,15 @@ if _os.environ.get("DCNN_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["DCNN_PLATFORM"])
 
+from .utils.env import get_env as _get_env
+
+if _get_env("DCNN_DEBUG", False):
+    # the 'debug build' switch (reference ENABLE_DEBUG -> ASan,
+    # CMakeLists.txt:22): numeric sanitizers on for the whole process
+    from .core.debug import enable_debug_mode as _edm
+
+    _edm()
+
 from . import core, nn, ops, optim
 
 __all__ = ["core", "nn", "ops", "optim", "__version__"]

@@ -37,6 +37,8 @@ class TrainingConfig:
     snapshot_dir: Optional[str] = "model_snapshots"
     progress_interval: int = 100      # batches between progress prints (train.hpp:149-162)
     dtype: str = "float32"            # "float32" parity mode | "bfloat16" fast mode
+    debug: bool = False               # numeric sanitizers (reference ENABLE_DEBUG
+                                      # ASan build, CMakeLists.txt:22; core/debug.py)
 
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
@@ -55,6 +57,7 @@ class TrainingConfig:
             snapshot_dir=get_env("SNAPSHOT_DIR", base.snapshot_dir or "model_snapshots"),
             progress_interval=get_env("PROGRESS_INTERVAL", base.progress_interval),
             dtype=get_env("DTYPE", base.dtype),
+            debug=get_env("DCNN_DEBUG", base.debug),
         )
 
     def to_dict(self) -> dict:

@@ -1,0 +1,82 @@
+"""Debug mode: numeric sanitizers for training runs.
+
+Reference equivalent: the ``ENABLE_DEBUG`` build flag, which produces a
+Debug + AddressSanitizer build (``/root/reference/CMakeLists.txt:22,30-32``,
+``cmake/CompilerFlags.cmake``, ``build.sh --debug``). Memory errors are not a
+failure class for JAX programs (no manual buffers to overrun), so the
+TPU-native analog sanitizes the failure class that *does* exist here:
+silent numeric corruption (NaN/Inf propagation, out-of-bounds gathers
+clamping silently, div-by-zero producing Inf).
+
+Two tiers, both opt-in (like the reference's debug build):
+
+- :func:`enable_debug_mode` / :func:`debug_mode` — flips ``jax_debug_nans``
+  (every jitted computation re-checked; on NaN the op is re-run un-jitted to
+  pinpoint the producing primitive) and optionally ``jax_enable_checks``
+  (internal invariant checks). Process-global, like a sanitizer build.
+- :func:`checked` — wraps a jitted step with ``jax.experimental.checkify``
+  (float + index + div checks): the returned step raises a located error
+  (primitive + source line) instead of training on garbage. Works under jit
+  on any backend, including inside scans where jax_debug_nans cannot look.
+
+Env var ``DCNN_DEBUG=1`` (reference ``.env`` style, ``env.hpp:41``) enables
+the global mode at ``import dcnn_tpu``; ``TrainingConfig(debug=True)`` does
+the same per-trainer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+
+
+def enable_debug_mode(nans: bool = True, checks: bool = False) -> None:
+    """Process-global numeric sanitizer (the 'debug build')."""
+    jax.config.update("jax_debug_nans", bool(nans))
+    if checks:
+        jax.config.update("jax_enable_checks", True)
+
+
+def disable_debug_mode() -> None:
+    jax.config.update("jax_debug_nans", False)
+    jax.config.update("jax_enable_checks", False)
+
+
+@contextlib.contextmanager
+def debug_mode(nans: bool = True, checks: bool = False):
+    """Scoped debug mode; restores previous flags on exit."""
+    prev_nans = jax.config.jax_debug_nans
+    prev_checks = jax.config.jax_enable_checks
+    try:
+        enable_debug_mode(nans=nans, checks=checks)
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_enable_checks", prev_checks)
+
+
+def checked(step_fn: Callable, jit: bool = True) -> Callable:
+    """Wrap a (possibly jitted) step function with checkify float/index/div
+    checks. The wrapper raises ``jax.experimental.checkify.JaxRuntimeError``
+    with the failing primitive and source location the first time a NaN/Inf,
+    out-of-bounds index, or div-by-zero is produced — instead of training on
+    silently corrupted numbers.
+
+    ``step = checked(make_train_step(model, loss, opt, jit=False))``
+    """
+    from jax.experimental import checkify
+
+    errors = (checkify.float_checks | checkify.index_checks
+              | checkify.div_checks)
+    cf = checkify.checkify(step_fn, errors=errors)
+    if jit:
+        cf = jax.jit(cf)
+
+    def wrapper(*args, **kwargs):
+        err, out = cf(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper

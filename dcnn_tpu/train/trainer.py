@@ -192,6 +192,11 @@ class Trainer:
         self.optimizer = optimizer
         self.loss_fn = get_loss(loss) if isinstance(loss, str) else loss
         self.config = config or TrainingConfig()
+        if self.config.debug:
+            # the 'debug build' (reference ENABLE_DEBUG -> ASan): sanitize
+            # NaN/Inf production across every jitted step of this process
+            from ..core.debug import enable_debug_mode
+            enable_debug_mode()
         self.scheduler = scheduler
         self.profiler = (LayerProfiler(self.config.profiler)
                          if self.config.profiler != ProfilerType.NONE else None)

@@ -1,0 +1,97 @@
+"""Debug-mode tests (VERDICT r1 #10; reference ENABLE_DEBUG ASan build,
+``CMakeLists.txt:22,30-32``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.core.debug import checked, debug_mode, enable_debug_mode, disable_debug_mode
+
+
+def test_debug_mode_catches_nan():
+    @jax.jit
+    def f(x):
+        return jnp.log(x)  # log(-1) -> nan
+
+    with debug_mode():
+        with pytest.raises(FloatingPointError, match="[Nn]a[Nn]"):
+            f(jnp.asarray(-1.0)).block_until_ready()
+    # restored afterwards: same computation silently yields nan
+    assert jnp.isnan(f(jnp.asarray(-1.0)))
+
+
+def test_debug_mode_restores_flags_on_error():
+    prev = jax.config.jax_debug_nans
+    try:
+        with debug_mode():
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_checked_step_locates_nan():
+    from jax.experimental import checkify
+
+    def step(x, y):
+        return x / y  # 0/0 -> nan
+
+    safe = checked(step)
+    out = safe(jnp.asarray(1.0), jnp.asarray(2.0))
+    np.testing.assert_allclose(out, 0.5)
+    with pytest.raises(checkify.JaxRuntimeError, match="division by zero|nan"):
+        safe(jnp.asarray(0.0), jnp.asarray(0.0))
+
+
+def test_checked_train_step_on_model():
+    """A full train step wrapped in checkify: poisoned input raises a located
+    error instead of training on garbage."""
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.ops.losses import get_loss
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.train import make_train_step
+    from dcnn_tpu.train.trainer import create_train_state
+    from jax.experimental import checkify
+
+    model = (SequentialBuilder("dbg").input((1, 4, 4))
+             .conv2d(2, 3, 1, 1).activation("relu").flatten().dense(3).build())
+    opt = SGD(0.1)
+    step = checked(make_train_step(model, get_loss("softmax_crossentropy"),
+                                   opt, jit=False))
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).normal(size=(4, 1, 4, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    ts, loss, _ = step(ts, jnp.asarray(x), jnp.asarray(y),
+                       jax.random.PRNGKey(1), 0.1)
+    assert np.isfinite(float(loss))
+
+    x_bad = x.copy()
+    x_bad[0, 0, 0, 0] = np.inf
+    with pytest.raises(checkify.JaxRuntimeError):
+        step(ts, jnp.asarray(x_bad), jnp.asarray(y), jax.random.PRNGKey(1), 0.1)
+
+
+def test_trainer_config_enables_debug():
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.models import create_mnist_trainer
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.train import Trainer
+
+    prev = jax.config.jax_debug_nans
+    try:
+        Trainer(create_mnist_trainer(), Adam(1e-3), "softmax_crossentropy",
+                config=TrainingConfig(debug=True))
+        assert jax.config.jax_debug_nans is True
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+        jax.config.update("jax_enable_checks", False)
+
+
+def test_config_env_debug(monkeypatch):
+    from dcnn_tpu.core.config import TrainingConfig
+
+    monkeypatch.setenv("DCNN_DEBUG", "1")
+    assert TrainingConfig.load_from_env().debug is True
+    monkeypatch.setenv("DCNN_DEBUG", "0")
+    assert TrainingConfig.load_from_env().debug is False
