@@ -73,6 +73,35 @@ def create_cifar10_trainer_v2(data_format: str = "NCHW") -> Sequential:
     return b.build()
 
 
+def create_cnn_cifar100(data_format: str = "NCHW") -> Sequential:
+    """CIFAR-100 CNN: the reference's cifar100 trainer reuses the VGG-style
+    cifar10_v2 architecture verbatim (examples/cifar100_cnn_trainer.cpp:40-79)
+    — including a final ``dense(10)`` head even though CIFAR-100 has 100
+    classes (a latent reference bug: its loader one-hots to 100). Reproduced
+    layer-for-layer except the head, deliberately corrected to 100."""
+    shape = (3, 32, 32) if data_format == "NCHW" else (32, 32, 3)
+    b = (SequentialBuilder("cifar100_cnn_classifier", data_format)
+         .input(shape)
+         .conv2d(64, 3, 1, 1, False, "conv0").batchnorm(name="bn0").activation("relu", "relu0")
+         .conv2d(64, 3, 1, 1, False, "conv1").batchnorm(name="bn1").activation("relu", "relu1")
+         .maxpool2d(2, 2, 0, "pool0")
+         .conv2d(128, 3, 1, 1, False, "conv2").batchnorm(name="bn2").activation("relu", "relu2")
+         .conv2d(128, 3, 1, 1, False, "conv3").batchnorm(name="bn3").activation("relu", "relu3")
+         .maxpool2d(2, 2, 0, "pool1")
+         .conv2d(256, 3, 1, 1, False, "conv4").batchnorm(name="bn5").activation("relu", "relu5")
+         .conv2d(256, 3, 1, 1, False, "conv5").activation("relu", "relu6")
+         .conv2d(256, 3, 1, 1, False, "conv6").batchnorm(name="bn6").activation("relu", "relu6b")
+         .maxpool2d(2, 2, 0, "pool2")
+         .conv2d(512, 3, 1, 1, False, "conv7").batchnorm(name="bn8").activation("relu", "relu7")
+         .conv2d(512, 3, 1, 1, False, "conv8").batchnorm(name="bn9").activation("relu", "relu8")
+         .conv2d(512, 3, 1, 1, False, "conv9").batchnorm(name="bn10").activation("relu", "relu9")
+         .maxpool2d(2, 2, 0, "pool3")
+         .flatten("flatten")
+         .dense(512, True, "fc0").activation("relu", "relu10")
+         .dense(100, True, "fc1"))
+    return b.build()
+
+
 def create_resnet9_cifar10(data_format: str = "NCHW") -> Sequential:
     """ResNet-9 (example_models.hpp:95-134)."""
     shape = (3, 32, 32) if data_format == "NCHW" else (32, 32, 3)
@@ -293,6 +322,7 @@ MODEL_ZOO: Dict[str, Callable[..., Sequential]] = {
     "mnist_cnn": create_mnist_trainer,
     "cifar10_cnn_v1": create_cifar10_trainer_v1,
     "cifar10_cnn_v2": create_cifar10_trainer_v2,
+    "cnn_cifar100": create_cnn_cifar100,
     "resnet9_cifar10": create_resnet9_cifar10,
     "resnet18_cifar10": create_resnet18_cifar10,
     "resnet20_cifar10": create_resnet20_cifar10,

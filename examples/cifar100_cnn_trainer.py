@@ -1,0 +1,41 @@
+"""CIFAR-100 CNN trainer (reference ``examples/cifar100_cnn_trainer.cpp``):
+the VGG-style CNN on CIFAR-100 fine labels, Adam, crossentropy, best-val
+snapshot to model_snapshots/. Falls back to synthetic data when the dataset
+is absent (fetch with ``python -m dcnn_tpu.data.download --root data cifar100``).
+"""
+
+from common import loader_or_synthetic, setup
+
+from dcnn_tpu.data import CIFAR100DataLoader
+from dcnn_tpu.models import create_cnn_cifar100
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train import train_classification_model
+from dcnn_tpu.utils.env import get_env
+
+
+def main():
+    cfg = setup("cifar100_cnn")
+
+    def real():
+        root = get_env("CIFAR100_DIR", "data/cifar-100-binary")
+        train = CIFAR100DataLoader(f"{root}/train.bin", label_mode="fine",
+                                   batch_size=cfg.batch_size, seed=cfg.seed)
+        val = CIFAR100DataLoader(f"{root}/test.bin", label_mode="fine",
+                                 batch_size=cfg.batch_size, shuffle=False)
+        train.load_data()
+        val.load_data()
+        return train, val
+
+    train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 100, cfg)
+    model = create_cnn_cifar100()
+    print(model.summary())
+    # the reference pairs raw logits with its epsilon-clamped plain
+    # CrossEntropy (cifar100_cnn_trainer.cpp:86) — numerically fragile; the
+    # stable softmax-CE twin is the correct equivalent here (loss.hpp:122)
+    train_classification_model(model, Adam(cfg.learning_rate),
+                               "softmax_crossentropy", train_loader,
+                               val_loader, config=cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,19 @@ def test_config_roundtrip_resnet_nested_blocks():
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
 
 
+def test_cifar100_model_shapes_and_roundtrip():
+    """cnn_cifar100 (reference examples/cifar100_cnn_trainer.cpp:40-79;
+    100-class head, correcting the reference's dense(10) quirk)."""
+    model = create_model("cnn_cifar100")
+    assert model.output_shape() == (100,)
+    clone = Sequential.from_config(model.get_config())
+    assert clone.get_config() == model.get_config()
+    x = jax.random.normal(KEY, (2, 3, 32, 32))
+    p, s = model.init(KEY)
+    y, _ = model.apply(p, s, x)
+    assert y.shape == (2, 100)
+
+
 def test_split_partitions():
     model = create_mnist_trainer()
     n = len(model)
