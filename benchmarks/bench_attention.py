@@ -15,7 +15,8 @@ import sys
 
 import numpy as np
 
-from common import Result, check_match, print_table, report, time_callable, tiny_mode
+from common import (Result, check_match, outputs_as_args_feed, print_table,
+                    replace_feed, report, time_chained, tiny_mode)
 
 TOL = 5e-3   # bf16-accumulator-free paths all keep fp32 stats; loose enough
              # for bf16 MXU scores at S=2048
@@ -32,7 +33,7 @@ def run() -> dict:
 
     b, h, d = (2, 4, 64)
     seqs = [256] if tiny_mode() else [1024, 4096]
-    steps = 3 if tiny_mode() else 10
+    length = 2 if tiny_mode() else 8
     results = []
     rng = np.random.default_rng(0)
     on_tpu = jax.default_backend() == "tpu"
@@ -55,7 +56,8 @@ def run() -> dict:
         for name, fn in impls.items():
             got = fn(dq, dk, dv)
             ok, err = check_match(got, want, TOL)
-            dt = time_callable(lambda: fn(dq, dk, dv), steps=steps)
+            # attention output has q's shape: feed it back as q
+            dt = time_chained(fn, (dq, dk, dv), replace_feed(0), length=length)
             results.append(Result(f"attn_fwd_{name}_S{s}", dt,
                                   flops / dt / 1e12, "TFLOP/s", ok, err))
 
@@ -71,7 +73,9 @@ def run() -> dict:
             got_g = gfn(dq, dk, dv)
             oks, errs = zip(*(check_match(gg, wg, TOL)
                               for gg, wg in zip(got_g, want_g)))
-            dt = time_callable(lambda: gfn(dq, dk, dv), steps=steps)
+            # (dq,dk,dv) grads match (q,k,v) shapes: full tuple replacement
+            dt = time_chained(gfn, (dq, dk, dv), outputs_as_args_feed(),
+                              length=length)
             results.append(Result(f"attn_bwd_{name}_S{s}", dt,
                                   3.5 * flops / dt / 1e12, "TFLOP/s",
                                   all(oks), max(errs)))

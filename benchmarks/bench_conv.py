@@ -16,7 +16,8 @@ import sys
 
 import numpy as np
 
-from common import Result, check_match, print_table, report, time_callable, tiny_mode
+from common import (Result, check_match, dep_feed, print_table, report,
+                    time_chained, tiny_mode)
 
 # (cin, cout, hw, kernel, stride, pad) — ResNet-18 tiny-imagenet trunk shapes
 # (models/zoo.py create_resnet18_tiny_imagenet)
@@ -49,7 +50,7 @@ def run() -> dict:
 
     batch = 16 if tiny_mode() else 128
     shapes = SHAPES[:3] if tiny_mode() else SHAPES
-    steps = 5 if tiny_mode() else 10
+    length = 4 if tiny_mode() else 16
     results = []
     rng = np.random.default_rng(0)
     for mode in ("parity", "fast"):
@@ -72,7 +73,9 @@ def run() -> dict:
             ok, err = check_match(got, _torch_conv_fp64(x, w, s, p), TOLS[mode])
             oh = got.shape[2]
             flops = 2.0 * batch * cout * cin * k * k * oh * oh
-            dt = time_callable(lambda: fwd(dx, dw, stride=s, padding=p), steps=steps)
+            dt = time_chained(
+                lambda xx, ww: fwd(xx, ww, stride=s, padding=p),
+                (dx, dw), dep_feed(0), length=length)
             results.append(Result(f"conv_fwd_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))
 
@@ -88,17 +91,19 @@ def run() -> dict:
 
             got_wg = wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p)
             ok, err = check_match(got_wg, want_wg, TOLS[mode])
-            dt = time_callable(
-                lambda: wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p),
-                steps=steps)
+            dt = time_chained(
+                lambda xx, gg: wgrad(xx, gg, kernel_hw=(k, k), stride=s,
+                                     padding=p),
+                (dx, dg), dep_feed(0), length=length)
             results.append(Result(f"conv_wgrad_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))
 
             got_ig = igrad(dw, dg, input_shape=x.shape, stride=s, padding=p)
             ok, err = check_match(got_ig, want_ig, TOLS[mode])
-            dt = time_callable(
-                lambda: igrad(dw, dg, input_shape=x.shape, stride=s, padding=p),
-                steps=steps)
+            dt = time_chained(
+                lambda ww, gg: igrad(ww, gg, input_shape=x.shape, stride=s,
+                                     padding=p),
+                (dw, dg), dep_feed(0), length=length)
             results.append(Result(f"conv_igrad_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))
     set_precision("parity")

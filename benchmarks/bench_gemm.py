@@ -14,7 +14,8 @@ import sys
 
 import numpy as np
 
-from common import Result, check_match, print_table, report, time_callable, tiny_mode
+from common import (Result, check_match, print_table, replace_feed, report,
+                    time_chained, tiny_mode)
 
 SIZES = [(512, 512, 512), (1024, 1024, 1024), (2048, 2048, 2048),
          (4096, 4096, 4096)]
@@ -43,7 +44,13 @@ def run() -> dict:
             da, db = jax.device_put(a), jax.device_put(b)
             got = mm(da, db)
             ok, err = check_match(got, a.astype(np.float64) @ b, TOLS[mode])
-            dt = time_callable(lambda: mm(da, db), steps=5 if tiny_mode() else 10)
+            # iteration count scaled inversely with FLOPs so the timed delta
+            # stays well above tunnel jitter even for sub-ms matmuls
+            length = (8 if tiny_mode()
+                      else max(32, min(2048, int(32 * (4096 / m) ** 2))))
+            # square matmul: the output IS the next iteration's lhs — full
+            # consumption, zero dependency overhead
+            dt = time_chained(mm, (da, db), replace_feed(0), length=length)
             gflops = 2.0 * m * n * k / dt / 1e9
             results.append(Result(
                 name=f"gemm_{m}x{n}x{k}_{mode}", seconds=dt, rate=gflops,

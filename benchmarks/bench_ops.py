@@ -13,7 +13,8 @@ import sys
 
 import numpy as np
 
-from common import Result, check_match, print_table, report, time_callable, tiny_mode
+from common import (Result, check_match, dep_feed, print_table, replace_feed,
+                    report, time_chained, tiny_mode)
 
 TOL = 1e-5
 
@@ -24,7 +25,7 @@ def run() -> dict:
     from dcnn_tpu.ops import elementwise as ew
 
     n = (1 << 20) if tiny_mode() else (1 << 26)   # 4 MiB / 256 MiB fp32
-    steps = 5 if tiny_mode() else 10
+    length = 8 if tiny_mode() else 64
     rng = np.random.default_rng(0)
     a = rng.standard_normal(n).astype(np.float32)
     b = rng.standard_normal(n).astype(np.float32)
@@ -33,45 +34,52 @@ def run() -> dict:
     a64, b64, c64 = a.astype(np.float64), b.astype(np.float64), c.astype(np.float64)
     itemsize = 4
 
-    # (name, jitted fn, host oracle, arrays touched r+w)
+    # (name, fn, host oracle, arrays touched r+w). Full-size outputs use
+    # replace_feed (output becomes next input: full consumption, zero
+    # overhead); scalar-output reductions use dep_feed (the reduction itself
+    # is the full consumption, and the feed's extra work is O(1)).
     cases = [
-        ("add", jax.jit(ew.add), lambda: a64 + b64, 3),
-        ("fmadd", jax.jit(ew.fmadd), lambda: a64 * b64 + c64, 4),
-        ("axpy", jax.jit(lambda x, y: ew.axpy(2.5, x, y)),
-         lambda: 2.5 * a64 + b64, 3),
-        ("sqrt_abs", jax.jit(lambda x: ew.sqrt(ew.abs(x))),
+        ("add", ew.add, lambda: a64 + b64, 3),
+        ("fmadd", ew.fmadd, lambda: a64 * b64 + c64, 4),
+        ("axpy", lambda x, y: ew.axpy(2.5, x, y), lambda: 2.5 * a64 + b64, 3),
+        ("sqrt_abs", lambda x: ew.sqrt(ew.abs(x)),
          lambda: np.sqrt(np.abs(a64)), 2),
-        ("clamp", jax.jit(lambda x: ew.clamp(x, -1.0, 1.0)),
+        ("clamp", lambda x: ew.clamp(x, -1.0, 1.0),
          lambda: np.clip(a64, -1.0, 1.0), 2),
-        ("sum", jax.jit(ew.sum), lambda: a64.sum(), 1),
-        ("dot_product", jax.jit(ew.dot_product), lambda: a64 @ b64, 2),
+        ("sum", ew.sum, lambda: a64.sum(), 1),
+        ("dot_product", ew.dot_product, lambda: a64 @ b64, 2),
     ]
     results = []
     for name, fn, oracle, n_arrays in cases:
         args = {"add": (da, db), "fmadd": (da, db, dc), "axpy": (da, db),
                 "dot_product": (da, db)}.get(name, (da,))
-        got = fn(*args)
+        got = jax.jit(fn)(*args)
+        scalar_out = np.ndim(got) == 0
         # reductions over 2^26 elements accumulate ~n*eps error; scale tol
-        tol = TOL * (np.sqrt(n) / 100 if n_arrays < 3 and np.ndim(got) == 0 else 1.0)
+        tol = TOL * (np.sqrt(n) / 100 if scalar_out else 1.0)
         ok, err = check_match(got, oracle(), tol)
-        dt = time_callable(lambda: fn(*args), steps=steps)
+        feed = dep_feed(0) if scalar_out else replace_feed(0)
+        dt = time_chained(fn, args, feed, length=length)
         gbps = n_arrays * n * itemsize / dt / 1e9
         results.append(Result(f"ew_{name}", dt, gbps, "GB/s", ok, err))
 
     # layout moves (the reference's nchw<->cnhw/nhwc transposes — on TPU
-    # these are real HBM-bound relayouts worth tracking)
-    shape = (8, 64, 32, 32) if tiny_mode() else (64, 128, 64, 64)
-    x4 = rng.standard_normal(shape).astype(np.float32)
-    dx4 = jax.device_put(x4)
-    for name, fn, oracle in [
-        ("nchw_to_nhwc", jax.jit(ew.nchw_to_nhwc),
-         lambda: x4.transpose(0, 2, 3, 1)),
-        ("nchw_to_cnhw", jax.jit(ew.nchw_to_cnhw),
-         lambda: x4.transpose(1, 0, 2, 3)),
+    # these are real HBM-bound relayouts worth tracking). Shapes chosen so
+    # the permutation preserves the array shape (B==C for the swap,
+    # C==H==W for the cycle): the output feeds back as the input
+    # (replace_feed = full consumption), while each scan body still executes
+    # one real data movement.
+    for name, fn, shape, perm in [
+        ("nchw_to_nhwc", ew.nchw_to_nhwc,
+         (8, 16, 16, 16) if tiny_mode() else (32, 64, 64, 64), (0, 2, 3, 1)),
+        ("nchw_to_cnhw", ew.nchw_to_cnhw,
+         (16, 16, 12, 12) if tiny_mode() else (64, 64, 48, 48), (1, 0, 2, 3)),
     ]:
-        got = fn(dx4)
-        ok, err = check_match(got, oracle(), TOL)
-        dt = time_callable(lambda: fn(dx4), steps=steps)
+        x4 = rng.standard_normal(shape).astype(np.float32)
+        dx4 = jax.device_put(x4)
+        got = jax.jit(fn)(dx4)
+        ok, err = check_match(got, x4.transpose(perm), TOL)
+        dt = time_chained(fn, (dx4,), replace_feed(0), length=length)
         gbps = 2 * x4.nbytes / dt / 1e9
         results.append(Result(f"layout_{name}", dt, gbps, "GB/s", ok, err))
     return report("tensor_ops", results, meta={"elements": n})

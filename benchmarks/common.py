@@ -89,6 +89,128 @@ def time_callable(fn: Callable[[], Any], steps: int = 10, reps: int = 3,
     return best / steps
 
 
+def time_chained(op: Callable, args: tuple, feed: Callable,
+                 length: int = 32, reps: int = 5) -> float:
+    """Per-iteration seconds for ``length`` data-dependent iterations of
+    ``op`` inside ONE jitted dispatch (``lax.scan``).
+
+    On tunnelled/remote PJRT backends a single dispatch costs ~10 ms wall
+    regardless of the op, so ``time_callable`` measures the tunnel, not the
+    chip, for any op under ~10 ms. Chaining amortizes the dispatch to
+    ``1/length`` while the data dependency (``feed(out, args) -> args`` must
+    thread the output back into the next iteration's inputs) stops XLA from
+    collapsing the loop. ``feed`` must preserve the args pytree
+    structure/shapes/dtypes (scan carry invariant).
+
+    Even one fence is expensive through the tunnel (~30-100 ms round trips —
+    measured: a scalar pull on an already-ready array costs ~99 ms), so a
+    single-length measurement is still constant-biased. This uses the
+    **two-length difference method**: time the scan at ``length`` and at
+    ``length // 4`` and divide the delta by the iteration delta — every
+    constant cost (dispatch RPC, fence RTT, first/last-iteration DCE
+    asymmetries) cancels exactly. The fence probe is a scalar computed
+    *inside* the jit (one element per carry leaf), so awaiting it is a single
+    D2H round trip.
+
+    On the CPU backend this falls back to per-dispatch timing: local dispatch
+    costs ~µs (no tunnel to amortize), while XLA:CPU runs loop bodies
+    single-threaded, which would make chained numbers 10-20x worse than the
+    op's real multi-threaded performance."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() == "cpu":
+        jfn = jax.jit(lambda a: op(*a))
+        return time_callable(lambda: jfn(args), steps=min(length, 10),
+                             reps=reps)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(a, n):
+        def body(c, _):
+            return feed(op(*c), c), None
+
+        c, _ = lax.scan(body, a, None, length=n)
+        # in-jit scalar probe: one element per carry leaf, summed — awaiting
+        # this is one D2H round trip and cannot complete before the scan does
+        return sum(jnp.sum(l.reshape(-1)[0]).astype(jnp.float32)
+                   for l in jax.tree_util.tree_leaves(c))
+
+    length = max(2, length)   # the difference method needs short < length
+    short = max(1, length // 4)
+
+    def timed(n: int) -> float:
+        probe = run(args, n)   # compile + warm this length
+        jax.device_get(probe)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            probe = run(args, n)
+            jax.device_get(probe)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_long = timed(length)
+    t_short = timed(short)
+    if t_long > t_short:
+        return (t_long - t_short) / (length - short)
+    # degenerate (op so cheap it drowns in jitter): fall back to the
+    # long-run average, which at worst over-reports the time
+    return t_long / length
+
+
+def replace_feed(i: int = 0):
+    """Feed for time_chained when the op output has the same shape/dtype as
+    ``args[i]``: the output simply becomes the next iteration's input. Full
+    consumption of the output (XLA cannot dead-code or slice-sink any of the
+    timed work) at zero added cost. Values may drift to inf over iterations —
+    harmless for timing; TPU float arithmetic is constant-time."""
+
+    def feed(out, args):
+        new = list(args)
+        new[i] = out
+        return tuple(new)
+
+    return feed
+
+
+def outputs_as_args_feed():
+    """Feed for ops whose output tuple matches the args tuple elementwise
+    (e.g. a grad function over its own inputs)."""
+
+    def feed(out, args):
+        return tuple(out)
+
+    return feed
+
+
+def dep_feed(i: int):
+    """Generic feed for shape-mismatched ops: fold a FULL reduction of every
+    output leaf into a one-element perturbation of args[i].
+
+    The full ``jnp.sum`` matters: consuming a single output element would let
+    XLA slice-sink through the (single-user) producer and shrink the timed op
+    to the one element the probe reads — e.g. a GEMM collapses to one K-dot.
+    A whole-output reduction forces every element to exist. Cost: one extra
+    read of the output per iteration — negligible for FLOP-bound ops; prefer
+    :func:`replace_feed` (zero-cost) whenever shapes allow."""
+    import jax
+    import jax.numpy as jnp
+
+    def feed(out, args):
+        leaves = ([out] if hasattr(out, "dtype")
+                  else jax.tree_util.tree_leaves(out))
+        eps = sum(jnp.sum(l).astype(jnp.float32) for l in leaves) * 1e-30
+        new = list(args)
+        a = new[i]
+        new[i] = a.at[(0,) * a.ndim].add(eps.astype(a.dtype))
+        return tuple(new)
+
+    return feed
+
+
 def report(section: str, results: List[Result], out_path: Optional[str] = None,
            meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Assemble + optionally persist one section's machine-readable report."""
