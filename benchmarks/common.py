@@ -133,9 +133,16 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
             return feed(op(*c), c), None
 
         c, _ = lax.scan(body, a, None, length=n)
-        # in-jit scalar probe: one element per carry leaf, summed — awaiting
-        # this is one D2H round trip and cannot complete before the scan does
-        return sum(jnp.sum(l.reshape(-1)[0]).astype(jnp.float32)
+        # in-jit scalar probe: a FULL reduction of every carry leaf. A
+        # single-element probe is not enough — XLA slice-sinks through the
+        # carried matmul chain (a[0,0] needs only row 0 of the previous
+        # carry, inductively collapsing every iteration to row@matrix; we
+        # measured impossible >500 TFLOP/s numbers that way). A full sum
+        # needs every element of the final carry, so every iteration runs at
+        # full width; its own cost is one reduction per *run*, amortized to
+        # nothing by the difference method. Awaiting the scalar is one D2H
+        # round trip.
+        return sum(jnp.sum(l).astype(jnp.float32)
                    for l in jax.tree_util.tree_leaves(c))
 
     length = max(2, length)   # the difference method needs short < length

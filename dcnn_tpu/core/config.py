@@ -39,6 +39,9 @@ class TrainingConfig:
     dtype: str = "float32"            # "float32" parity mode | "bfloat16" fast mode
     debug: bool = False               # numeric sanitizers (reference ENABLE_DEBUG
                                       # ASan build, CMakeLists.txt:22; core/debug.py)
+    scheduler_step: str = "epoch"     # "epoch" (reference cadence, train.hpp:282-288)
+                                      # | "batch" (what OneCycleLR/WarmupCosine are
+                                      # usually sized for: total_steps = epochs*batches)
 
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
@@ -58,6 +61,7 @@ class TrainingConfig:
             progress_interval=get_env("PROGRESS_INTERVAL", base.progress_interval),
             dtype=get_env("DTYPE", base.dtype),
             debug=get_env("DCNN_DEBUG", base.debug),
+            scheduler_step=get_env("SCHEDULER_STEP", base.scheduler_step),
         )
 
     def to_dict(self) -> dict:

@@ -218,6 +218,12 @@ class Trainer:
             total_correct += int(correct_count(logits, y))
             total_n += x.shape[0]
             batches += 1
+            if (self.scheduler is not None
+                    and self.config.scheduler_step == "batch"):
+                # per-batch cadence: what OneCycleLR/WarmupCosine are sized
+                # for (total_steps = epochs * batches_per_epoch); the metric
+                # is the running train loss (val loss doesn't exist mid-epoch)
+                self.lr = self.scheduler.step(total_loss / total_n)
             if self.config.progress_interval and (bi + 1) % self.config.progress_interval == 0:
                 dt = time.perf_counter() - t0
                 print(f"  epoch {epoch} batch {bi + 1}: loss {total_loss / total_n:.4f} "
@@ -285,8 +291,9 @@ class Trainer:
             print(msg + f" | {dt:.1f}s lr {self.lr:.2e}", flush=True)
 
             # LR schedule: scheduler wins; else multiplicative decay
-            # (reference train.hpp:282-288).
-            if self.scheduler is not None:
+            # (reference train.hpp:282-288). Per-batch schedulers already
+            # stepped inside train_epoch.
+            if self.scheduler is not None and cfg.scheduler_step == "epoch":
                 self.lr = self.scheduler.step(val_loss if val_loss is not None else train_loss)
             elif cfg.lr_decay_factor != 1.0 and epoch % cfg.lr_decay_interval == 0:
                 self.lr *= cfg.lr_decay_factor
