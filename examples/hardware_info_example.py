@@ -1,0 +1,32 @@
+"""Hardware/diagnostics dump (reference ``examples/hardware_info_example.cpp``
+and ``device_manager_example.cpp``): devices the runtime discovered, HBM
+stats, host memory, and a tiny compute sanity check per device."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.core.device import DeviceManager
+from dcnn_tpu.utils.hardware import HardwareInfo, get_memory_usage_kb
+
+
+def main():
+    HardwareInfo.print_info()
+    print(f"\nprocess RSS: {get_memory_usage_kb() / 1024:.1f} MiB")
+
+    dm = DeviceManager.instance()
+    print(f"\nDeviceManager: {len(dm.all())} device(s); "
+          f"default = {dm.default().id}")
+    for info in dm.all():
+        y = jax.device_put(jnp.arange(8.0), info.device) * 2.0
+        ok = float(y.sum()) == 56.0
+        print(f"  {info.id} ({info.platform}): compute check "
+              f"{'OK' if ok else 'FAILED'}")
+
+
+if __name__ == "__main__":
+    main()
