@@ -31,18 +31,29 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``mask``: broadcastable to (B, H, Sq, Sk); True = attend. O(S²) memory —
     the numerics oracle for the blockwise/pallas/ring variants.
+
+    Fully-masked rows return 0 (zero softmax mass), the same convention as
+    :func:`blockwise_attention` / :func:`flash_attention` — NOT the uniform
+    average a plain softmax over all-NEG_INF scores would produce.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         precision=get_precision()) * scale
+    allowed = None
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
-        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        scores = jnp.where(causal_mask, scores, NEG_INF)
+        allowed = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
     if mask is not None:
-        scores = jnp.where(mask, scores, NEG_INF)
+        allowed = mask if allowed is None else (allowed & mask)
+    if allowed is not None:
+        scores = jnp.where(allowed, scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
+    if allowed is not None:
+        # zero fully-masked rows (softmax of all-NEG_INF is uniform 1/Sk)
+        any_allowed = jnp.any(jnp.broadcast_to(allowed, scores.shape),
+                              axis=-1, keepdims=True)
+        weights = jnp.where(any_allowed, weights, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v,
                       precision=get_precision())
 
@@ -77,16 +88,20 @@ def _online_block(acc, m, l, q, k_blk, v_blk, scale, score_mask):
 @functools.partial(jax.jit, static_argnames=("causal", "block_kv", "scale"))
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = False, block_kv: int = 512,
-                        scale: Optional[float] = None) -> jax.Array:
+                        scale: Optional[float] = None,
+                        mask: Optional[jax.Array] = None) -> jax.Array:
     """Flash-style attention: online softmax over K/V blocks via ``lax.scan``
     — never materialises the (Sq, Sk) score matrix. Exact (not approximate);
     matches :func:`attention` to float tolerance.
 
-    Masking: only ``causal`` is supported on this memory-efficient path (and
-    on :func:`flash_attention`); arbitrary masks require the materialising
-    :func:`attention` oracle. Fully-masked rows return 0 here (zero softmax
-    mass), whereas the oracle returns a uniform average over all positions —
-    callers adding padding masks must not rely on fully-masked-row output.
+    Masking: ``causal`` plus an optional arbitrary ``mask`` broadcastable to
+    (B, H, Sq, Sk), True = attend (padding/segment masks). The mask is
+    consumed one K/V block at a time, so this path keeps its O(Sq·block_kv)
+    working set (the caller's mask array itself may of course be O(Sq·Sk) —
+    pass broadcastable singleton dims where possible). Fully-masked rows
+    return 0 (zero softmax mass), the same convention as :func:`attention`.
+    The Pallas :func:`flash_attention` kernel remains causal-only; masked
+    calls route here.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -101,6 +116,16 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kb = k.reshape(b, h, nblk, block_kv, d).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(b, h, nblk, block_kv, d).transpose(2, 0, 1, 3, 4)
 
+    if mask is not None:
+        mask = jnp.asarray(mask, bool)
+        while mask.ndim < 4:
+            mask = mask[None]
+        if mask.shape[-1] not in (1, sk):
+            raise ValueError(
+                f"mask last dim {mask.shape[-1]} must be 1 or Sk={sk}")
+        if pad and mask.shape[-1] == sk:
+            mask = jnp.pad(mask, ((0, 0),) * 3 + ((0, pad),))
+
     q_pos = jnp.arange(sq)                       # global query positions
     diag_offset = sk - sq                        # causal diag when Sq != Sk
 
@@ -111,11 +136,17 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         valid = kv_pos < sk                      # padding mask
         if causal:
             allowed = kv_pos[None, :] <= (q_pos[:, None] + diag_offset)
-            score_mask = allowed & valid[None, :]
+            score_mask = (allowed & valid[None, :])[None, None]
         else:
-            score_mask = jnp.broadcast_to(valid[None, :], (sq, block_kv))
+            score_mask = jnp.broadcast_to(valid[None, :],
+                                          (sq, block_kv))[None, None]
+        if mask is not None:
+            mask_blk = (mask if mask.shape[-1] == 1 else
+                        jax.lax.dynamic_slice_in_dim(
+                            mask, blk_idx * block_kv, block_kv, axis=-1))
+            score_mask = score_mask & mask_blk
         acc, m, l = _online_block(acc, m, l, q, k_blk, v_blk, scale,
-                                  score_mask[None, None])
+                                  score_mask)
         return (acc, m, l), None
 
     # fp32 online-softmax state irrespective of q.dtype (see _online_block)
@@ -257,7 +288,8 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, block_q: int = 256,
                     block_kv: int = 256, scale: Optional[float] = None,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
     """Pallas flash-attention forward (online softmax, scores stay in VMEM),
     differentiable via recompute-based VJP. Causal-only masking (see
     :func:`blockwise_attention` docstring). Falls back to
@@ -268,6 +300,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if mask is not None:
+        # the Pallas kernel is causal-only; arbitrary masks take the
+        # numerically-equivalent blockwise path (same memory profile)
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_kv=block_kv, scale=scale, mask=mask)
     if not _HAVE_PALLAS:
         if interpret:
             raise RuntimeError(
