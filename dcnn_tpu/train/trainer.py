@@ -84,7 +84,15 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
     def step(ts: TrainState, x, y, rng, lr):
         # Shapes are static at trace time: a trailing partial batch (any
         # drop_last=False loader) that doesn't divide evenly falls back to
-        # one whole-batch microbatch rather than crashing the reshape.
+        # one whole-batch microbatch rather than crashing the reshape. The
+        # fallback changes BN batch-statistics semantics (one big batch vs
+        # N small ones), so it warns — once per traced shape.
+        if num_microbatches > 1 and x.shape[0] % num_microbatches != 0:
+            import warnings
+            warnings.warn(
+                f"batch size {x.shape[0]} not divisible by num_microbatches="
+                f"{num_microbatches}: training this batch unmicrobatched "
+                f"(different BN statistics semantics)", stacklevel=2)
         if num_microbatches == 1 or x.shape[0] % num_microbatches != 0:
             (loss, (logits, new_state)), grads = grad_fn(ts.params, ts.state, x, y, rng)
         else:

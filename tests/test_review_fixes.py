@@ -28,10 +28,16 @@ def test_microbatch_step_handles_indivisible_batch():
     ts = create_train_state(model, opt, KEY)
     step = make_train_step(model, softmax_cross_entropy, opt,
                            num_microbatches=4, donate=False)
-    # 10 % 4 != 0 → falls back to single microbatch instead of crashing
+    # 10 % 4 != 0 → falls back to single microbatch instead of crashing,
+    # and warns at trace time (BN statistics semantics change)
+    import warnings
+
     x = jax.random.normal(KEY, (10, 4))
     y = jax.nn.one_hot(jnp.arange(10) % 3, 3)
-    ts, loss, logits = step(ts, x, y, KEY, 0.1)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ts, loss, logits = step(ts, x, y, KEY, 0.1)
+    assert any("not divisible" in str(x.message) for x in w)
     assert np.isfinite(float(loss)) and logits.shape == (10, 3)
 
 
