@@ -116,8 +116,6 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     costs ~µs (no tunnel to amortize), while XLA:CPU runs loop bodies
     single-threaded, which would make chained numbers 10-20x worse than the
     op's real multi-threaded performance."""
-    import functools
-
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -127,12 +125,18 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
         return time_callable(lambda: jfn(args), steps=min(length, 10),
                              reps=reps)
 
-    @functools.partial(jax.jit, static_argnums=(1,))
+    @jax.jit
     def run(a, n):
-        def body(c, _):
-            return feed(op(*c), c), None
+        # RUNTIME trip count (n is traced, not static): one executable
+        # serves both lengths, so the difference method compares literally
+        # identical code — a static length would let XLA pick different
+        # unroll regimes for the long and short runs, breaking the
+        # equal-constant-cost assumption (observed as impossible TFLOP/s on
+        # small fast-mode matmuls).
+        def body(i, c):
+            return feed(op(*c), c)
 
-        c, _ = lax.scan(body, a, None, length=n)
+        c = lax.fori_loop(0, n, body, a)
         # in-jit scalar probe: a FULL reduction of every carry leaf. A
         # single-element probe is not enough — XLA slice-sinks through the
         # carried matmul chain (a[0,0] needs only row 0 of the previous
@@ -146,26 +150,40 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
                    for l in jax.tree_util.tree_leaves(c))
 
     length = max(2, length)   # the difference method needs short < length
-    short = max(1, length // 4)
 
-    def timed(n: int) -> float:
-        probe = run(args, n)   # compile + warm this length
-        jax.device_get(probe)
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            probe = run(args, n)
-            jax.device_get(probe)
-            best = min(best, time.perf_counter() - t0)
-        return best
+    def one(n: int) -> float:
+        t0 = time.perf_counter()
+        jax.device_get(run(args, jnp.int32(n)))
+        return time.perf_counter() - t0
 
-    t_long = timed(length)
-    t_short = timed(short)
-    if t_long > t_short:
-        return (t_long - t_short) / (length - short)
-    # degenerate (op so cheap it drowns in jitter): fall back to the
-    # long-run average, which at worst over-reports the time
-    return t_long / length
+    # compile + warm (single executable for all lengths)
+    jax.device_get(run(args, jnp.int32(length)))
+
+    # PAIRED differences, median-combined: taking independent best-of-reps
+    # for each length lets slow tunnel drift between the two measurement
+    # groups fake the delta (observed: impossible >300 TFLOP/s on small
+    # matmuls). Back-to-back pairs see the same tunnel conditions; the
+    # median rejects outlier round trips. If the delta is still below the
+    # tunnel noise floor (several ms of RTT jitter), escalate the iteration
+    # count — the runtime trip count makes longer runs free of recompiles.
+    NOISE_FLOOR = 0.05           # seconds the delta must clear
+    MAX_LENGTH = 1 << 18
+    while True:
+        short = max(1, length // 4)
+        diffs = sorted(one(length) - one(short) for _ in range(reps))
+        delta = diffs[len(diffs) // 2]
+        if delta >= NOISE_FLOOR or length >= MAX_LENGTH:
+            break
+        # scale so the next delta lands ~2x the floor (est <= true per-iter
+        # cost is fine: it only means one extra escalation round)
+        est = max(delta / (length - short), 1e-9)
+        length = min(MAX_LENGTH,
+                     max(length * 2, int(2 * NOISE_FLOOR / est * 1.34)))
+    if delta > 0:
+        return delta / (length - short)
+    # degenerate (op so cheap it drowns in jitter even at MAX_LENGTH):
+    # fall back to the long-run average, which at worst over-reports
+    return one(length) / length
 
 
 def replace_feed(i: int = 0):
