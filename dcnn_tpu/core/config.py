@@ -42,6 +42,10 @@ class TrainingConfig:
     scheduler_step: str = "epoch"     # "epoch" (reference cadence, train.hpp:282-288)
                                       # | "batch" (what OneCycleLR/WarmupCosine are
                                       # usually sized for: total_steps = epochs*batches)
+    steps_per_dispatch: int = 1       # >1: expect [K,B,...] chunks (PrefetchLoader
+                                      # stage_batches=K) and run K train steps per
+                                      # device dispatch (train.make_multi_step) —
+                                      # the remote/tunnelled-TPU fast path
 
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
@@ -62,6 +66,8 @@ class TrainingConfig:
             dtype=get_env("DTYPE", base.dtype),
             debug=get_env("DCNN_DEBUG", base.debug),
             scheduler_step=get_env("SCHEDULER_STEP", base.scheduler_step),
+            steps_per_dispatch=get_env("STEPS_PER_DISPATCH",
+                                       base.steps_per_dispatch),
         )
 
     def to_dict(self) -> dict:

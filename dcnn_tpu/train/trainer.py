@@ -210,12 +210,21 @@ class Trainer:
                          if self.config.profiler != ProfilerType.NONE else None)
         self.train_step = make_train_step(model, self.loss_fn, optimizer,
                                           self.config.num_microbatches)
+        # chunked fast path: one device dispatch per K train steps. The
+        # loader must yield [K, B, ...] stacks (PrefetchLoader with
+        # stage_batches=K); per-batch logits/accuracy are not materialized
+        # in this mode (the loss is the per-chunk mean).
+        self.multi_step = (make_multi_step(model, self.loss_fn, optimizer,
+                                           self.config.num_microbatches)
+                           if self.config.steps_per_dispatch > 1 else None)
         self.eval_step = make_eval_step(model, self.loss_fn)
         self.lr = self.config.learning_rate
         self.history: list = []
 
     def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
                     epoch: int = 0) -> Tuple[TrainState, float, float]:
+        if self.multi_step is not None:
+            return self._train_epoch_chunked(ts, loader, rng, epoch)
         total_loss, total_correct, total_n, batches = 0.0, 0, 0, 0
         t0 = time.perf_counter()
         for bi, (x, y) in enumerate(loader):
@@ -238,6 +247,32 @@ class Trainer:
                       f"acc {total_correct / total_n:.4f} "
                       f"({total_n / dt:.1f} samples/s)", flush=True)
         return ts, (total_loss / max(total_n, 1)), (total_correct / max(total_n, 1))
+
+    def _train_epoch_chunked(self, ts: TrainState, loader, rng: jax.Array,
+                             epoch: int = 0) -> Tuple[TrainState, float, float]:
+        """K train steps per device dispatch over [K, B, ...] chunks.
+        Per-batch logits are not materialized, so train accuracy is reported
+        as NaN (validation still measures real accuracy)."""
+        total_loss, total_n = 0.0, 0
+        t0 = time.perf_counter()
+        for ci, (xs, ys) in enumerate(loader):
+            xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+            chunk_rng = jax.random.fold_in(rng, ci)
+            ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, self.lr)
+            n = xs.shape[0] * xs.shape[1]
+            total_loss += float(mean_loss) * n
+            total_n += n
+            if (self.scheduler is not None
+                    and self.config.scheduler_step == "batch"):
+                for _ in range(xs.shape[0]):
+                    self.lr = self.scheduler.step(total_loss / total_n)
+            if self.config.progress_interval and (ci + 1) % max(
+                    self.config.progress_interval // max(xs.shape[0], 1), 1) == 0:
+                dt = time.perf_counter() - t0
+                print(f"  epoch {epoch} chunk {ci + 1}: loss "
+                      f"{total_loss / total_n:.4f} "
+                      f"({total_n / dt:.1f} samples/s)", flush=True)
+        return ts, total_loss / max(total_n, 1), float("nan")
 
     def fit(self, ts: TrainState, train_loader, val_loader=None,
             epochs: Optional[int] = None, seed: Optional[int] = None) -> TrainState:

@@ -181,6 +181,52 @@ def test_trainer_per_batch_scheduler_stepping():
     np.testing.assert_allclose(max(lrs), 0.4, rtol=1e-6)
 
 
+def test_trainer_chunked_dispatch_matches_per_batch():
+    """steps_per_dispatch=K (PrefetchLoader chunks + make_multi_step) must
+    train identically to the per-batch path (same updates, same epoch loss)."""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data import ArrayDataLoader, PrefetchLoader
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.train import Trainer
+    from dcnn_tpu.train.trainer import create_train_state
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 1, 8, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+
+    def mk_model():
+        return (SequentialBuilder("chunk_model").input((1, 8, 8))
+                .conv2d(2, 3, 1, 1).activation("relu").flatten().dense(4)
+                .build())
+
+    def mk_loader():
+        ld = ArrayDataLoader(x, y, batch_size=8, shuffle=False)
+        ld.load_data()
+        return ld
+
+    results = {}
+    for mode, spd in (("batch", 1), ("chunked", 4)):
+        model = mk_model()
+        opt = SGD(0.05)
+        tr = Trainer(model, opt, "softmax_crossentropy",
+                     config=TrainingConfig(epochs=2, progress_interval=0,
+                                           snapshot_dir=None,
+                                           steps_per_dispatch=spd))
+        ts = create_train_state(model, opt, KEY)
+        loader = (mk_loader() if spd == 1
+                  else PrefetchLoader(mk_loader(), stage_batches=spd))
+        ts = tr.fit(ts, loader)
+        results[mode] = (ts, [h["train_loss"] for h in tr.history])
+
+    for a, b in zip(jax.tree_util.tree_leaves(results["batch"][0].params),
+                    jax.tree_util.tree_leaves(results["chunked"][0].params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(results["batch"][1], results["chunked"][1],
+                               rtol=1e-5)
+
+
 def test_trainer_fit_best_val_snapshot(tmp_path):
     """Trainer.fit writes the best-val snapshot (reference train.hpp:254-264)
     and the checkpoint round-trips through the factory."""
