@@ -167,18 +167,35 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     # tunnel noise floor (several ms of RTT jitter), escalate the iteration
     # count — the runtime trip count makes longer runs free of recompiles.
     NOISE_FLOOR = 0.05           # seconds the delta must clear
-    MAX_LENGTH = 1 << 18
+    MAX_LENGTH = 1 << 16
+    MAX_RUN_WALL = 8.0           # never schedule a device loop much past
+                                 # this — long single kernels can trip the
+                                 # TPU watchdog and kill the worker process
     while True:
         short = max(1, length // 4)
-        diffs = sorted(one(length) - one(short) for _ in range(reps))
+        t_longs, diffs = [], []
+        for _ in range(reps):
+            tl = one(length)
+            diffs.append(tl - one(short))
+            t_longs.append(tl)
+        diffs.sort()
         delta = diffs[len(diffs) // 2]
-        if delta >= NOISE_FLOOR or length >= MAX_LENGTH:
+        t_long = sorted(t_longs)[len(t_longs) // 2]
+        if delta >= NOISE_FLOOR or length >= MAX_LENGTH or t_long >= MAX_RUN_WALL:
             break
-        # scale so the next delta lands ~2x the floor (est <= true per-iter
-        # cost is fine: it only means one extra escalation round)
-        est = max(delta / (length - short), 1e-9)
-        length = min(MAX_LENGTH,
-                     max(length * 2, int(2 * NOISE_FLOOR / est * 1.34)))
+        if delta > 0:
+            # scale so the next delta lands ~2x the floor, bounded by the
+            # per-run wall guard (measured t_long is the ground truth for
+            # how expensive this loop really is)
+            est = delta / (length - short)
+            target = max(length * 2, int(2 * NOISE_FLOOR / est * 1.34))
+            wall_cap = max(length * 2, int(length * MAX_RUN_WALL / max(t_long, 1e-3)))
+            length = min(MAX_LENGTH, target, wall_cap)
+        else:
+            # delta lost in jitter: escalate gently — a huge jump here
+            # (est~0 => max length) once produced a quarter-million-iteration
+            # kernel that crashed the TPU worker
+            length = min(MAX_LENGTH, length * 4)
     if delta > 0:
         return delta / (length - short)
     # degenerate (op so cheap it drowns in jitter even at MAX_LENGTH):
