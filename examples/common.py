@@ -23,6 +23,16 @@ def setup(name: str) -> TrainingConfig:
     return cfg
 
 
+def with_prefetch(loader, cfg):
+    """Wrap the train loader in the prefetching input pipeline: background
+    batch prep + H2D overlap, and — when cfg.steps_per_dispatch > 1 — K-batch
+    chunked staging feeding the Trainer's multi-step fast path."""
+    from dcnn_tpu.data import PrefetchLoader
+
+    return PrefetchLoader(loader, depth=2,
+                          stage_batches=max(cfg.steps_per_dispatch, 1))
+
+
 def loader_or_synthetic(make_real, image_shape, num_classes, cfg,
                         n_train=2048, n_val=512):
     """Use the real dataset if its path exists, else synthetic data so every

@@ -2,7 +2,7 @@
 / ``resnet34`` / ``resnet50``). Pick the depth with MODEL=resnet18|resnet34|
 resnet50|resnet9|cnn (env), dataset root with TINY_IMAGENET_DIR."""
 
-from common import loader_or_synthetic, setup
+from common import loader_or_synthetic, setup, with_prefetch
 
 from dcnn_tpu.data import AugmentationBuilder, TinyImageNetDataLoader
 from dcnn_tpu.models import create_model
@@ -31,6 +31,7 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (3, 64, 64), 200, cfg)
+    train_loader = with_prefetch(train_loader, cfg)
     model = create_model(model_name)
     print(model.summary())
     sched = WarmupCosineAnnealing(cfg.learning_rate, warmup_steps=2,
