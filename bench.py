@@ -25,7 +25,7 @@ Timing is robust to dispatch jitter from the TPU tunnel: BENCH_REPS
 repetitions of BENCH_STEPS steps each, best repetition reported (standard
 throughput practice — the steady-state capability of the chip).
 
-Env knobs: BENCH_BATCH (default 512), BENCH_STEPS (default 20), BENCH_REPS
+Env knobs: BENCH_BATCH (default 1024), BENCH_STEPS (default 20), BENCH_REPS
 (default 3), DCNN_PRECISION (default bf16 = mixed-precision activations;
 "fast" = bf16 MXU with fp32 storage; "parity" for fp32), BENCH_CHUNK
 (train steps per device dispatch via the in-jit train loop
@@ -233,7 +233,9 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     root = os.path.dirname(os.path.abspath(__file__))
-    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    # 1024 measured best on v5e (22.4k img/s / 37% MFU vs 21.2k at 512,
+    # 21.5k at 2048)
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
