@@ -286,17 +286,22 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False, block_q: int = 256,
-                    block_kv: int = 256, scale: Optional[float] = None,
+                    causal: bool = False, block_q: int = 1024,
+                    block_kv: int = 512, scale: Optional[float] = None,
                     interpret: Optional[bool] = None,
                     mask: Optional[jax.Array] = None) -> jax.Array:
     """Pallas flash-attention forward (online softmax, scores stay in VMEM),
-    differentiable via recompute-based VJP. Causal-only masking (see
-    :func:`blockwise_attention` docstring). Falls back to
-    :func:`blockwise_attention` — numerically equivalent, same memory
-    profile — when Pallas is unavailable *or* the backend is not TPU;
-    pass ``interpret=True`` explicitly to force the (slow) Pallas
-    interpreter off-TPU for kernel tests.
+    differentiable via recompute-based VJP. Causal-only masking in the kernel
+    (see :func:`blockwise_attention` docstring); ``mask`` routes to the
+    blockwise path. Falls back to :func:`blockwise_attention` — numerically
+    equivalent, same memory profile — when Pallas is unavailable *or* the
+    backend is not TPU; pass ``interpret=True`` explicitly to force the
+    (slow) Pallas interpreter off-TPU for kernel tests.
+
+    Default block sizes are the measured v5e optimum (causal S=4096 b4·h8·
+    d64 sweep: q1024/kv512 = 7.35 TFLOP/s vs 6.22 for the XLA blockwise scan
+    and 5.46 for the previous 256/256 blocks); both are clamped to the
+    sequence length, so short sequences are unaffected.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
