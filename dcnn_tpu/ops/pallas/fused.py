@@ -7,6 +7,13 @@ XLA usually fuses this too; the kernel exists as the template for the
 framework's Pallas surface (grid/block layout, NHWC channel-lane tiling) and
 is validated bit-for-bit against the jnp composition in tests.
 
+Measured (v5e, [1024,16,16,256] fp32, chained-iteration timing): XLA's
+automatic fusion reaches 658 GB/s vs 327 GB/s for this kernel — so the
+production path deliberately uses the jnp composition and lets XLA fuse;
+Pallas earns its keep where XLA can't restructure the computation (see the
+flash-attention kernel, which beats the XLA blockwise scan by 18% with tuned
+block shapes). This matches SURVEY §7 Stage 4's profile-first doctrine.
+
 Layout: NHWC with C on the lane dimension (128-wide) — the TPU-native choice;
 callers in NCHW transpose at the boundary (XLA folds the transpose).
 """
