@@ -34,7 +34,9 @@ from .compiled_pipeline import (
     shard_stacked, stack_stage_params,
 )
 from .sequence import (
-    SEQ_AXIS, make_ring_attention, make_ulysses_attention, shard_sequence,
+    SEQ_AXIS, make_ring_attention, make_ulysses_attention,
+    make_zigzag_ring_attention, shard_sequence, zigzag_permutation,
+    zigzag_shard,
 )
 from .distributed_pipeline import (
     DistributedPipelineCoordinator, PipelineWorkerError,
@@ -50,7 +52,8 @@ __all__ = [
     "make_compiled_pipeline_forward",
     "make_compiled_pipeline_train_step", "shard_stacked", "stack_stage_params",
     "SEQ_AXIS", "make_ring_attention", "make_ulysses_attention",
-    "shard_sequence",
+    "make_zigzag_ring_attention", "shard_sequence", "zigzag_permutation",
+    "zigzag_shard",
     "DistributedPipelineCoordinator", "PipelineWorkerError",
     "StageWorker", "run_worker",
 ]

@@ -119,8 +119,8 @@ def make_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
     Causal mode skips the attention compute for fully-masked rounds
     (kv owner ahead of the query shard): device i accumulates only i+1 of
     the n rounds, halving total FLOPs. Rotations still run every round
-    (uniform collectives). Zigzag block layout (balancing the skip across
-    devices so wall-clock also halves) is a known future optimisation.
+    (uniform collectives). The skip is imbalanced (device n-1 never skips);
+    :func:`make_zigzag_ring_attention` balances it so wall-clock also drops.
     """
     n = mesh.shape[axis]
 
@@ -138,6 +138,133 @@ def make_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
         s = q.shape[-1] ** -0.5 if scale is None else scale
         local = functools.partial(_ring_local, axis=axis, n=n,
                                   causal=causal, scale=s)
+        spec = P(None, None, axis, None)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return jax.jit(f)
+
+
+def zigzag_permutation(seq_len: int, n: int) -> "jnp.ndarray":
+    """Sequence-position permutation for zigzag ring attention: split the
+    sequence into 2n blocks; device i owns blocks i and 2n-1-i. Returns
+    ``perm`` such that ``x[:, :, perm]`` is in zigzag order (device shards
+    are then the usual contiguous S/n slices). Invert with
+    ``jnp.argsort(perm)``."""
+    if seq_len % (2 * n):
+        raise ValueError(f"zigzag needs seq_len ({seq_len}) divisible by "
+                         f"2*n ({2 * n})")
+    c = seq_len // (2 * n)
+    blocks = []
+    for i in range(n):
+        blocks.append(jnp.arange(i * c, (i + 1) * c))
+        j = 2 * n - 1 - i
+        blocks.append(jnp.arange(j * c, (j + 1) * c))
+    return jnp.concatenate(blocks)
+
+
+def zigzag_shard(tree, mesh: Mesh, axis: str = SEQ_AXIS, seq_dim: int = 2):
+    """Permute (B, H, S, D) arrays into zigzag order and shard S over
+    ``axis``. The paired :func:`make_zigzag_ring_attention` output is in the
+    same zigzag order; recover natural order with
+    ``out.take(jnp.argsort(zigzag_permutation(S, n)), axis=2)``."""
+    n = mesh.shape[axis]
+
+    def put(x):
+        perm = zigzag_permutation(x.shape[seq_dim], n)
+        return jnp.take(x, perm, axis=seq_dim)
+    return shard_sequence(jax.tree_util.tree_map(put, tree), mesh, axis,
+                          seq_dim)
+
+
+def _zigzag_local(q, k, v, *, axis: str, n: int, scale: float):
+    """Per-device body for causal zigzag ring attention. The local S/n rows
+    are TWO chunks: block ``idx`` (early positions) and block ``2n-1-idx``
+    (late positions). Each arriving kv shard likewise carries blocks
+    ``src`` and ``2n-1-src``; each of the 4 (q-chunk, kv-chunk) pairs is
+    computed only when not fully masked. Per round, the number of live pairs
+    per device is constant (2n+1 live of 4n total across all rounds), so —
+    unlike the plain causal ring, where device n-1 computes every round
+    while device 0 computes once — wall-clock drops with the FLOPs."""
+    idx = jax.lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    c = s_loc // 2
+    qa, qb = q[:, :, :c], q[:, :, c:]
+
+    def init_state():
+        return (jnp.zeros((b, h, c, d), jnp.float32),
+                jnp.full((b, h, c), NEG_INF, jnp.float32),
+                jnp.zeros((b, h, c), jnp.float32))
+
+    st_a, st_b = init_state(), init_state()
+    off_qa = idx * c
+    off_qb = (2 * n - 1 - idx) * c
+    pos = jnp.arange(c)
+    perm = [(dd, (dd + 1) % n) for dd in range(n)]
+
+    def pair(state, q_chunk, off_q, k_chunk, v_chunk, off_k):
+        """Accumulate one (q-chunk, kv-chunk) pair unless fully masked."""
+        def compute(st):
+            m_ok = (off_k + pos)[None, :] <= (off_q + pos)[:, None]
+            return _online_block(st[0], st[1], st[2], q_chunk, k_chunk,
+                                 v_chunk, scale, m_ok[None, None])
+
+        # fully masked iff the earliest key is after the latest query
+        return jax.lax.cond(off_k > off_q + c - 1, lambda st: st, compute,
+                            state)
+
+    def accumulate(st_a, st_b, k_cur, v_cur, src):
+        ka, kb = k_cur[:, :, :c], k_cur[:, :, c:]
+        va, vb = v_cur[:, :, :c], v_cur[:, :, c:]
+        off_ka = src * c
+        off_kb = (2 * n - 1 - src) * c
+        st_a = pair(st_a, qa, off_qa, ka, va, off_ka)
+        st_a = pair(st_a, qa, off_qa, kb, vb, off_kb)
+        st_b = pair(st_b, qb, off_qb, ka, va, off_ka)
+        st_b = pair(st_b, qb, off_qb, kb, vb, off_kb)
+        return st_a, st_b
+
+    st_a, st_b = accumulate(st_a, st_b, k, v, idx)   # own shard, no rotation
+
+    def round_t(t, carry):
+        st_a, st_b, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        src = (idx - t) % n
+        st_a, st_b = accumulate(st_a, st_b, k_cur, v_cur, src)
+        return st_a, st_b, k_cur, v_cur
+
+    st_a, st_b, _, _ = jax.lax.fori_loop(1, n, round_t, (st_a, st_b, k, v))
+
+    def finalize(st):
+        acc, m, l = st
+        return acc / jnp.maximum(l, 1e-30)[..., None]
+
+    return jnp.concatenate([finalize(st_a), finalize(st_b)],
+                           axis=2).astype(q.dtype)
+
+
+def make_zigzag_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
+                               scale: Optional[float] = None):
+    """Causal ring attention over zigzag-sharded sequences: same numerics as
+    :func:`make_ring_attention` (causal=True) but with the causal-skip work
+    balanced across the ring, so the skipped rounds buy wall-clock, not just
+    FLOPs. Inputs must be in zigzag order (:func:`zigzag_shard` /
+    :func:`zigzag_permutation`); the output is in the same order. Requires
+    S divisible by 2*n. Causal only — for non-causal use the plain ring,
+    which is already balanced."""
+    n = mesh.shape[axis]
+
+    def f(q, k, v):
+        nonlocal scale
+        if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
+            raise ValueError("zigzag ring requires equal q/k/v lengths")
+        if q.shape[2] % (2 * n):
+            raise ValueError(
+                f"zigzag ring needs sequence length ({q.shape[2]}) divisible "
+                f"by 2*mesh axis size ({2 * n}); pad the sequence")
+        s = q.shape[-1] ** -0.5 if scale is None else scale
+        local = functools.partial(_zigzag_local, axis=axis, n=n, scale=s)
         spec = P(None, None, axis, None)
         return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
