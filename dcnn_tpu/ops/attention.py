@@ -45,6 +45,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         sq, sk = scores.shape[-2], scores.shape[-1]
         allowed = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
     if mask is not None:
+        mask = jnp.asarray(mask, bool)   # accept 0/1 float masks like jnp.where did
         allowed = mask if allowed is None else (allowed & mask)
     if allowed is not None:
         scores = jnp.where(allowed, scores, NEG_INF)

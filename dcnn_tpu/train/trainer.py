@@ -136,18 +136,23 @@ def make_multi_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
     sequential ``make_train_step`` calls (per-batch BN stats, per-batch
     optimizer updates, per-step folded rng) — only the dispatch granularity
     changes. The reference has no analog (its CUDA stream dispatch is local
-    and cheap); this is pure TPU-runtime design."""
+    and cheap); this is pure TPU-runtime design.
+
+    ``lr`` may be a scalar or a [K] vector (one lr per inner step) — the
+    latter keeps per-batch LR schedules exact under chunked dispatch."""
     base = make_train_step(model, loss_fn, optimizer,
                            num_microbatches=num_microbatches, jit=False)
 
     def multi_step(ts: TrainState, xs, ys, rng, lr):
+        lrs = jnp.broadcast_to(jnp.asarray(lr, jnp.float32), (xs.shape[0],))
+
         def body(carry, xyi):
-            x, y, i = xyi
-            new_ts, loss, _ = base(carry, x, y, jax.random.fold_in(rng, i), lr)
+            x, y, i, lr_i = xyi
+            new_ts, loss, _ = base(carry, x, y, jax.random.fold_in(rng, i), lr_i)
             return new_ts, loss
 
         ts, losses = jax.lax.scan(
-            body, ts, (xs, ys, jnp.arange(xs.shape[0])))
+            body, ts, (xs, ys, jnp.arange(xs.shape[0]), lrs))
         return ts, jnp.mean(losses)
 
     return jax.jit(multi_step, donate_argnums=(0,) if donate else ())
@@ -252,20 +257,38 @@ class Trainer:
                              epoch: int = 0) -> Tuple[TrainState, float, float]:
         """K train steps per device dispatch over [K, B, ...] chunks.
         Per-batch logits are not materialized, so train accuracy is reported
-        as NaN (validation still measures real accuracy)."""
+        as NaN (validation still measures real accuracy). Per-batch LR
+        schedules stay exact: the K per-step lrs are precomputed on the host
+        and shipped as a vector into the scan (metric-driven schedulers see
+        the pre-chunk running loss instead of intermediate losses — the one
+        documented approximation)."""
+        sample_ndim = len(self.model.input_shape)
         total_loss, total_n = 0.0, 0
         t0 = time.perf_counter()
         for ci, (xs, ys) in enumerate(loader):
             xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+            if xs.ndim != sample_ndim + 2:
+                raise ValueError(
+                    f"steps_per_dispatch={self.config.steps_per_dispatch} "
+                    f"needs [K, B, ...] chunks (got shape {xs.shape}); wrap "
+                    f"the loader in PrefetchLoader(stage_batches=K) / "
+                    f"examples.common.with_prefetch")
             chunk_rng = jax.random.fold_in(rng, ci)
-            ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, self.lr)
+            per_batch_sched = (self.scheduler is not None
+                               and self.config.scheduler_step == "batch")
+            if per_batch_sched:
+                metric = total_loss / max(total_n, 1)
+                lrs = []
+                for _ in range(xs.shape[0]):
+                    lrs.append(self.lr)
+                    self.lr = self.scheduler.step(metric)
+                lr_arg = jnp.asarray(lrs, jnp.float32)
+            else:
+                lr_arg = self.lr
+            ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, lr_arg)
             n = xs.shape[0] * xs.shape[1]
             total_loss += float(mean_loss) * n
             total_n += n
-            if (self.scheduler is not None
-                    and self.config.scheduler_step == "batch"):
-                for _ in range(xs.shape[0]):
-                    self.lr = self.scheduler.step(total_loss / total_n)
             if self.config.progress_interval and (ci + 1) % max(
                     self.config.progress_interval // max(xs.shape[0], 1), 1) == 0:
                 dt = time.perf_counter() - t0
@@ -298,6 +321,9 @@ class Trainer:
                     # LayerProfiler runs its own untimed warm pass per
                     # (model, shape, dtype, precision) before timing, so one
                     # profiled fwd/bwd here is steady-state.
+                    if self.multi_step is not None:
+                        # chunked loader yields [K, B, ...]: profile one batch
+                        x, y = x[0], y[0]
                     x = jnp.asarray(x)
                     logits, _ = self.profiler.profile_forward(
                         self.model, ts.params, ts.state, x,

@@ -9,26 +9,17 @@ Usage: DCNN_PLATFORM=cpu python examples/backend_comparison.py   # host-only
 
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dcnn_tpu.core.fence import hard_fence
+from common import time_callable   # benchmarks/common.py timing harness
 from dcnn_tpu.ops import conv as conv_ops
-
-
-def _time(fn, *args, steps=5):
-    out = fn(*args)
-    hard_fence(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    hard_fence(out)
-    return (time.perf_counter() - t0) / steps, out
 
 
 def main():
@@ -60,8 +51,8 @@ def main():
         for dname, dev in devices.items():
             dargs = tuple(jax.device_put(v, dev) for v in args)
             jfn = jax.jit(fn, device=dev)
-            dt, out = _time(jfn, *dargs)
-            outs[dname] = np.asarray(out)
+            outs[dname] = np.asarray(jfn(*dargs))
+            dt = time_callable(lambda: jfn(*dargs), steps=5)
             cols.append(f"{flops / dt / 1e9:>11.1f} GF")
         vals = list(outs.values())
         err = (np.max(np.abs(vals[0] - vals[-1]))

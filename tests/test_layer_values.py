@@ -205,19 +205,25 @@ def test_trainer_chunked_dispatch_matches_per_batch():
         ld.load_data()
         return ld
 
+    from dcnn_tpu.optim import OneCycleLR
+
     results = {}
+    # per-batch OneCycleLR: the chunked path must ship a [K] lr vector so
+    # per-batch schedules stay EXACT under chunked dispatch
     for mode, spd in (("batch", 1), ("chunked", 4)):
         model = mk_model()
-        opt = SGD(0.05)
-        tr = Trainer(model, opt, "softmax_crossentropy",
+        sched = OneCycleLR(max_lr=0.1, total_steps=8, pct_start=0.5)
+        opt = SGD(sched.lr)
+        tr = Trainer(model, opt, "softmax_crossentropy", scheduler=sched,
                      config=TrainingConfig(epochs=2, progress_interval=0,
                                            snapshot_dir=None,
+                                           scheduler_step="batch",
                                            steps_per_dispatch=spd))
         ts = create_train_state(model, opt, KEY)
         loader = (mk_loader() if spd == 1
                   else PrefetchLoader(mk_loader(), stage_batches=spd))
         ts = tr.fit(ts, loader)
-        results[mode] = (ts, [h["train_loss"] for h in tr.history])
+        results[mode] = (ts, [h["train_loss"] for h in tr.history], tr.lr)
 
     for a, b in zip(jax.tree_util.tree_leaves(results["batch"][0].params),
                     jax.tree_util.tree_leaves(results["chunked"][0].params)):
@@ -225,6 +231,32 @@ def test_trainer_chunked_dispatch_matches_per_batch():
                                    rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(results["batch"][1], results["chunked"][1],
                                rtol=1e-5)
+    np.testing.assert_allclose(results["batch"][2], results["chunked"][2],
+                               rtol=1e-9)
+
+
+def test_trainer_chunked_rejects_unchunked_loader():
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data import ArrayDataLoader
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.train import Trainer
+    from dcnn_tpu.train.trainer import create_train_state
+
+    model = (SequentialBuilder("c").input((1, 8, 8))
+             .flatten().dense(4).build())
+    opt = SGD(0.05)
+    tr = Trainer(model, opt, "softmax_crossentropy",
+                 config=TrainingConfig(epochs=1, progress_interval=0,
+                                       snapshot_dir=None,
+                                       steps_per_dispatch=4))
+    ld = ArrayDataLoader(np.zeros((16, 1, 8, 8), np.float32),
+                         np.eye(4, dtype=np.float32)[np.zeros(16, int)],
+                         batch_size=8, shuffle=False)
+    ld.load_data()
+    ts = create_train_state(model, opt, KEY)
+    with pytest.raises(ValueError, match="PrefetchLoader"):
+        tr.fit(ts, ld)
 
 
 def test_trainer_fit_best_val_snapshot(tmp_path):
