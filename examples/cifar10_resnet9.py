@@ -1,7 +1,7 @@
 """CIFAR-10 ResNet-9 trainer (reference ``examples/cifar10_resnet9.cpp``)
 with the reference's augmentation recipe (random crop + hflip + cutout)."""
 
-from common import loader_or_synthetic, setup
+from common import loader_or_synthetic, setup, with_prefetch
 
 from dcnn_tpu.data import AugmentationBuilder, CIFAR10DataLoader
 from dcnn_tpu.models import create_resnet9_cifar10
@@ -30,6 +30,7 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 10, cfg)
+    train_loader = with_prefetch(train_loader, cfg)
     model = create_resnet9_cifar10()
     print(model.summary())
     steps = cfg.epochs * max(len(train_loader), 1)
