@@ -80,6 +80,7 @@ def make_compiled_pipeline_forward(
     num_microbatches: int,
     mesh: Mesh,
     remat: bool = True,
+    data_axis: Optional[str] = None,
 ):
     """Build ``forward(stacked_params, microbatches) -> outputs`` running the
     GPipe schedule in one jit.
@@ -90,6 +91,15 @@ def make_compiled_pipeline_forward(
     ``remat=True`` (default) checkpoints each stage application so backward
     recomputes intra-stage intermediates instead of keeping them live across
     the whole schedule.
+
+    ``data_axis``: name of a second mesh axis to data-parallelize over —
+    DP×PP composed in the same jit. The microbatch batch dim is sharded over
+    it (each data row of the mesh runs the full pipeline on its batch slice;
+    ppermutes ride within the row); stage params are replicated across rows,
+    so autodiff's shard_map transpose inserts the gradient psum over
+    ``data_axis`` automatically. The reference has no analog (its only
+    multi-device strategy is the pipeline); this is the pjit-era uplift
+    SURVEY.md §7 Stage 5(a) calls for, composed with Stage 5(b).
     """
     if num_microbatches < 1:
         raise ValueError("need at least one microbatch")
@@ -137,11 +147,12 @@ def make_compiled_pipeline_forward(
             STAGE_AXIS)
         return outputs
 
+    mb_spec = P(None, data_axis) if data_axis else P()
     smapped = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(STAGE_AXIS), P()),
-        out_specs=P(),
+        in_specs=(P(STAGE_AXIS), mb_spec),
+        out_specs=mb_spec,
         check_vma=False,
     )
     jitted = jax.jit(smapped)
@@ -167,6 +178,7 @@ def make_compiled_pipeline_train_step(
     num_microbatches: int,
     mesh: Mesh,
     remat: bool = True,
+    data_axis: Optional[str] = None,
 ):
     """One jitted train step over the compiled GPipe schedule:
     ``step(stacked_params, opt_state, mb_x, mb_y, lr) ->
@@ -174,10 +186,14 @@ def make_compiled_pipeline_train_step(
 
     Gradients come from autodiff through the scheduled forward (XLA
     transposes the ppermute rotation into the backward drain); the optimizer
-    update runs sharded — each device updates only its stage's slice.
+    update runs sharded — each device updates only its stage's slice. With
+    ``data_axis`` set (2-D mesh), the same jit also data-parallelizes over
+    that axis: batch sharded, gradient psum inserted by the transpose —
+    DP×PP in one dispatch.
     """
     fwd = make_compiled_pipeline_forward(stage_fn, num_stages,
-                                         num_microbatches, mesh, remat=remat)
+                                         num_microbatches, mesh, remat=remat,
+                                         data_axis=data_axis)
 
     def loss_of(params, mb_x, mb_y):
         outs = fwd(params, mb_x)
