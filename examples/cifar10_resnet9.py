@@ -33,8 +33,11 @@ def main():
     train_loader = with_prefetch(train_loader, cfg)
     model = create_resnet9_cifar10()
     print(model.summary())
-    steps = cfg.epochs * max(len(train_loader), 1)
-    sched = OneCycleLR(max_lr=cfg.learning_rate, total_steps=cfg.epochs)
+    # scheduler cadence follows cfg.scheduler_step: per-epoch (default) sizes
+    # the cycle in epochs; set SCHEDULER_STEP=batch to size it in batches
+    total = (cfg.epochs if cfg.scheduler_step == "epoch"
+             else cfg.epochs * max(len(train_loader), 1))
+    sched = OneCycleLR(max_lr=cfg.learning_rate, total_steps=total)
     train_classification_model(model, Adam(cfg.learning_rate, weight_decay=1e-4,
                                            decouple_weight_decay=True),
                                "softmax_crossentropy", train_loader, val_loader,

@@ -58,8 +58,12 @@ def test_load_env_file_parsing(tmp_path, monkeypatch):
     p = tmp_path / ".env"
     p.write_text("# comment\n\nA_KEY = 42\nB_KEY='quoted value'\n"
                  "C_KEY=\"dq\"\nmalformed line\n")
+    # setenv-then-delenv records the keys' original absence on monkeypatch's
+    # restore stack, so the direct os.environ writes load_env_file makes are
+    # cleaned up at teardown instead of leaking into later tests
     for k in ("A_KEY", "B_KEY", "C_KEY"):
-        monkeypatch.delenv(k, raising=False)
+        monkeypatch.setenv(k, "placeholder")
+        monkeypatch.delenv(k)
     assert load_env_file(str(p)) is True
     assert get_env("A_KEY", 0) == 42
     assert get_env("B_KEY", "") == "quoted value"
