@@ -318,6 +318,35 @@ def create_resnet50_imagenet(data_format: str = "NCHW") -> Sequential:
             .dense(1000, True, "fc").build())
 
 
+def create_mha_classifier(data_format: str = "NCHW") -> Sequential:
+    """Small self-attention sequence classifier: 2 MHA blocks + dense head
+    on (S=32, E=64) inputs. No reference analog (the reference is CNN-only,
+    SURVEY.md §5.7) — this makes the long-context subsystem a first-class
+    zoo citizen: built by the factory, trainable by the Trainer,
+    checkpointable, and pipeline-splittable like every CNN model.
+    ``data_format`` is accepted for zoo-signature uniformity and ignored."""
+    from ..nn.attention_layer import MultiHeadAttentionLayer
+    from ..nn.residual import ResidualBlock
+
+    def attn_block(name: str) -> ResidualBlock:
+        # out = relu(attn(x) + x): the residual keeps token identity intact
+        # (without it, two stacked softmax mixes average per-token features
+        # toward the sequence mean and the head sees almost no per-example
+        # signal — measured logits-std over a batch of 5e-4)
+        return ResidualBlock(
+            layers=[MultiHeadAttentionLayer(num_heads=4, impl="flash",
+                                            name=f"{name}_mha")],
+            shortcut=[], activation="relu", name=name)
+
+    return (SequentialBuilder("mha_classifier")
+            .input((32, 64))
+            .add_layer(attn_block("attn0"))
+            .add_layer(attn_block("attn1"))
+            .flatten("flatten")
+            .dense(10, True, "head")
+            .build())
+
+
 MODEL_ZOO: Dict[str, Callable[..., Sequential]] = {
     "mnist_cnn": create_mnist_trainer,
     "cifar10_cnn_v1": create_cifar10_trainer_v1,
@@ -333,6 +362,7 @@ MODEL_ZOO: Dict[str, Callable[..., Sequential]] = {
     "resnet34_tiny_imagenet": create_resnet34_tiny_imagenet,
     "resnet50_tiny_imagenet": create_resnet50_tiny_imagenet,
     "resnet50_imagenet": create_resnet50_imagenet,
+    "mha_classifier": create_mha_classifier,
 }
 
 
