@@ -63,6 +63,37 @@ def get_compute_dtype() -> Optional[Any]:
     return jnp.bfloat16 if _current == "bf16" else None
 
 
+def precision_keyed_jit(f, **jit_kwargs):
+    """``jax.jit`` with the global precision mode added to the cache key.
+
+    Ops read the mode at trace time, so a ``set_precision`` switch must force
+    a re-trace — fp32 inputs alone hash identically and would keep serving
+    the previously-traced executable (ADVICE r2 #4). Any module-level jit
+    whose trace reads :func:`get_precision` / :func:`get_compute_dtype` must
+    use this instead of ``jax.jit``. Extra ``static_argnames`` compose (pass
+    those arguments by keyword). The underlying jitted function is exposed as
+    ``wrapped._jitted`` (e.g. for cache-size introspection in tests).
+    """
+    import functools
+
+    def g(*args, _precision_mode=None, **kwargs):
+        del _precision_mode  # cache key only
+        return f(*args, **kwargs)
+
+    extra = jit_kwargs.pop("static_argnames", ())
+    if isinstance(extra, str):   # jax.jit accepts a bare string; match it
+        extra = (extra,)
+    static = tuple(extra) + ("_precision_mode",)
+    jg = jax.jit(g, static_argnames=static, **jit_kwargs)
+
+    @functools.wraps(f)
+    def wrapped(*args, **kwargs):
+        return jg(*args, _precision_mode=get_precision_mode(), **kwargs)
+
+    wrapped._jitted = jg
+    return wrapped
+
+
 def cast_to_compute(tree: Any) -> Any:
     """Cast every floating leaf of ``tree`` to the compute dtype (no-op unless
     mode is bf16). Used on params *at point of use* — master copies stay fp32,

@@ -19,9 +19,30 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..core.precision import get_precision
+from ..core.precision import get_precision, precision_keyed_jit
 
 NEG_INF = -1e30
+
+
+def _check_mask_rank(mask: jax.Array) -> jax.Array:
+    """Masks must be 2-D (Sq, Sk) or 4-D (B|1, H|1, Sq, Sk). 3-D masks are
+    rejected: a (B, Sq, Sk) key-padding mask would silently broadcast as
+    (1, H=B, Sq, Sk) — head-aligned, not batch-aligned — whenever B == H
+    (ADVICE r2 #5). Callers with a batch mask must add the head axis
+    explicitly: ``mask[:, None]``."""
+    mask = jnp.asarray(mask, bool)   # accept 0/1 float masks like jnp.where did
+    if mask.ndim == 3:
+        raise ValueError(
+            "3-D attention masks are ambiguous (batch- vs head-aligned); "
+            "pass (Sq, Sk) or (B|1, H|1, Sq, Sk) — for a batch key-padding "
+            "mask use mask[:, None].")
+    if mask.ndim > 4:
+        raise ValueError(
+            f"attention mask rank {mask.ndim} > 4; expected (Sq, Sk) or "
+            f"(B|1, H|1, Sq, Sk)")
+    while mask.ndim < 4:
+        mask = mask[None]
+    return mask
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -29,8 +50,9 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               scale: Optional[float] = None) -> jax.Array:
     """Reference (materialising) attention: ``softmax(q·kᵀ·scale)·v``.
 
-    ``mask``: broadcastable to (B, H, Sq, Sk); True = attend. O(S²) memory —
-    the numerics oracle for the blockwise/pallas/ring variants.
+    ``mask``: (Sq, Sk) or (B|1, H|1, Sq, Sk); True = attend (3-D rejected —
+    see :func:`_check_mask_rank`). O(S²) memory — the numerics oracle for the
+    blockwise/pallas/ring variants.
 
     Fully-masked rows return 0 (zero softmax mass), the same convention as
     :func:`blockwise_attention` / :func:`flash_attention` — NOT the uniform
@@ -45,7 +67,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         sq, sk = scores.shape[-2], scores.shape[-1]
         allowed = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
     if mask is not None:
-        mask = jnp.asarray(mask, bool)   # accept 0/1 float masks like jnp.where did
+        mask = _check_mask_rank(mask)
         allowed = mask if allowed is None else (allowed & mask)
     if allowed is not None:
         scores = jnp.where(allowed, scores, NEG_INF)
@@ -86,7 +108,6 @@ def _online_block(acc, m, l, q, k_blk, v_blk, scale, score_mask):
     return acc_new, m_new, l_new
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_kv", "scale"))
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = False, block_kv: int = 512,
                         scale: Optional[float] = None,
@@ -95,15 +116,25 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     — never materialises the (Sq, Sk) score matrix. Exact (not approximate);
     matches :func:`attention` to float tolerance.
 
-    Masking: ``causal`` plus an optional arbitrary ``mask`` broadcastable to
-    (B, H, Sq, Sk), True = attend (padding/segment masks). The mask is
-    consumed one K/V block at a time, so this path keeps its O(Sq·block_kv)
-    working set (the caller's mask array itself may of course be O(Sq·Sk) —
-    pass broadcastable singleton dims where possible). Fully-masked rows
-    return 0 (zero softmax mass), the same convention as :func:`attention`.
-    The Pallas :func:`flash_attention` kernel remains causal-only; masked
-    calls route here.
+    Masking: ``causal`` plus an optional ``mask`` of rank 2 (Sq, Sk) or 4
+    (B|1, H|1, Sq, Sk), True = attend (padding/segment masks; 3-D rejected —
+    see :func:`_check_mask_rank`). The mask is consumed one K/V block at a
+    time, so this path keeps its O(Sq·block_kv) working set (the caller's
+    mask array itself may of course be O(Sq·Sk) — pass broadcastable
+    singleton dims where possible). Fully-masked rows return 0 (zero softmax
+    mass), the same convention as :func:`attention`. The Pallas
+    :func:`flash_attention` kernel remains causal-only; masked calls route
+    here.
     """
+    if mask is not None:
+        mask = _check_mask_rank(mask)
+    return _blockwise_attention_jit(q, k, v, mask, causal=causal,
+                                    block_kv=block_kv, scale=scale)
+
+
+@functools.partial(precision_keyed_jit,
+                   static_argnames=("causal", "block_kv", "scale"))
+def _blockwise_attention_jit(q, k, v, mask, causal, block_kv, scale):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, h, sq, d = q.shape
@@ -118,9 +149,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     vb = v.reshape(b, h, nblk, block_kv, d).transpose(2, 0, 1, 3, 4)
 
     if mask is not None:
-        mask = jnp.asarray(mask, bool)
-        while mask.ndim < 4:
-            mask = mask[None]
+        mask = _check_mask_rank(mask)  # idempotent; guards direct callers
         if mask.shape[-1] not in (1, sk):
             raise ValueError(
                 f"mask last dim {mask.shape[-1]} must be 1 or Sk={sk}")

@@ -61,7 +61,7 @@ class DistributedPipelineCoordinator:
                  workers: Sequence[str],
                  partitioner: Optional[Partitioner] = None,
                  num_microbatches: int = 4,
-                 track_load: "bool | str" = "sample",
+                 track_load: "bool | str" = False,
                  compress: bool = False, timeout: float = 120.0):
         self.model = model
         self.optimizer = optimizer

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..core.mesh import SEQ_AXIS
+from ..core.precision import precision_keyed_jit
 from ..ops.attention import NEG_INF, _online_block
 
 
@@ -142,7 +143,7 @@ def make_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
         return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
-    return jax.jit(f)
+    return precision_keyed_jit(f)
 
 
 def zigzag_permutation(seq_len: int, n: int) -> "jnp.ndarray":
@@ -269,7 +270,7 @@ def make_zigzag_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
         return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
-    return jax.jit(f)
+    return precision_keyed_jit(f)
 
 
 def _ulysses_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
@@ -310,4 +311,4 @@ def make_ulysses_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
         return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
-    return jax.jit(f)
+    return precision_keyed_jit(f)

@@ -183,7 +183,7 @@ class StageWorker:
         self.is_last = meta["is_last"]
         self.stage = PipelineStage.from_config(
             self.stage_id, meta["model"], meta["optimizer"],
-            track_load=meta.get("track_load", "sample"))
+            track_load=meta.get("track_load", False))
 
         # weights arrive as one npz blob; rebuild pytrees against the
         # stage model's own init structure (same layer code ⇒ same treedef)

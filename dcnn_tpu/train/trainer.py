@@ -277,11 +277,18 @@ class Trainer:
             per_batch_sched = (self.scheduler is not None
                                and self.config.scheduler_step == "batch")
             if per_batch_sched:
-                metric = total_loss / max(total_n, 1)
+                # No loss exists yet for the first chunk: pass None so
+                # metric-driven schedulers (ReduceLROnPlateau) skip the
+                # update instead of seeing a spurious 0.0 "perfect" loss.
+                metric = (total_loss / total_n) if total_n > 0 else None
                 lrs = []
-                for _ in range(xs.shape[0]):
+                for si in range(xs.shape[0]):
                     lrs.append(self.lr)
-                    self.lr = self.scheduler.step(metric)
+                    # one metric evaluation per chunk: feeding the same value
+                    # K times would count K-1 spurious "no improvement" steps
+                    # per chunk in plateau schedulers (patience is therefore
+                    # measured in chunks when steps_per_dispatch > 1)
+                    self.lr = self.scheduler.step(metric if si == 0 else None)
                 lr_arg = jnp.asarray(lrs, jnp.float32)
             else:
                 lr_arg = self.lr

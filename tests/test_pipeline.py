@@ -132,7 +132,7 @@ def test_pipeline_stages_on_distinct_devices():
     model = _model()
     coord = InProcessPipelineCoordinator(
         model, SGD(0.01), "softmax_crossentropy",
-        num_stages=4, devices=devs[:4], num_microbatches=2)
+        num_stages=4, devices=devs[:4], num_microbatches=2, track_load=True)
     coord.deploy_stages(KEY)
     for stage, dev in zip(coord.stages, devs[:4]):
         leaf = jax.tree_util.tree_leaves(stage.params)[0]

@@ -57,6 +57,86 @@ def test_data_parallel_2d_input():
     assert np.isfinite(float(loss))
 
 
+def test_attention_rejects_3d_mask():
+    """ADVICE r2 #5: a (B, Sq, Sk) mask silently broadcast head-aligned when
+    B == H; both attention entry points must reject rank-3 masks."""
+    import pytest
+
+    from dcnn_tpu.ops.attention import attention, blockwise_attention
+
+    q = jax.random.normal(KEY, (2, 2, 8, 4))
+    bad = jnp.ones((2, 8, 8), bool)
+    with pytest.raises(ValueError, match="3-D attention masks"):
+        attention(q, q, q, mask=bad)
+    with pytest.raises(ValueError, match="3-D attention masks"):
+        blockwise_attention(q, q, q, mask=bad)
+    with pytest.raises(ValueError, match="rank 5"):
+        attention(q, q, q, mask=jnp.ones((1, 2, 2, 8, 8), bool))
+    # rank-2 and rank-4 still accepted
+    ok2 = attention(q, q, q, mask=jnp.ones((8, 8), bool))
+    ok4 = blockwise_attention(q, q, q, mask=jnp.ones((2, 1, 8, 8), bool))
+    assert ok2.shape == q.shape and ok4.shape == q.shape
+
+
+def test_blockwise_attention_retraced_on_precision_switch():
+    """ADVICE r2 #4: parity<->fast switches must hit different jit cache
+    entries (fp32 inputs hash identically, so the mode is a static key)."""
+    from dcnn_tpu.core.precision import get_precision_mode, set_precision
+    from dcnn_tpu.ops.attention import _blockwise_attention_jit, blockwise_attention
+
+    q = jax.random.normal(KEY, (1, 1, 32, 16))
+    cache = _blockwise_attention_jit._jitted._cache_size
+    mode0 = get_precision_mode()
+    try:
+        set_precision("parity")
+        blockwise_attention(q, q, q)
+        n0 = cache()
+        blockwise_attention(q, q, q)
+        assert cache() == n0  # same mode: cached
+        set_precision("fast")
+        blockwise_attention(q, q, q)
+        assert cache() == n0 + 1  # re-traced
+    finally:
+        set_precision(mode0)
+
+
+def test_chunked_first_chunk_scheduler_metric_is_none():
+    """ADVICE r2 #1: metric-driven schedulers must not see a spurious 0.0
+    loss from the first chunk of a chunked epoch."""
+    from dcnn_tpu.optim.schedulers import ReduceLROnPlateau
+    from dcnn_tpu.train.trainer import Trainer, TrainingConfig
+
+    model = SequentialBuilder("m").input((4,)).dense(3).build()
+    sched = ReduceLROnPlateau(0.1, patience=0, factor=0.5, threshold=0.0)
+    cfg = TrainingConfig(epochs=1, batch_size=4, scheduler_step="batch",
+                         steps_per_dispatch=2, progress_interval=0)
+    tr = Trainer(model, SGD(0.1), "softmax_crossentropy", cfg, sched)
+    ts = create_train_state(model, SGD(0.1), KEY)
+    rng = np.random.default_rng(0)
+    # one [K=2, B=4, 4] chunk; with the old 0.0 first-chunk metric the
+    # plateau scheduler records best=0.0 and every later real loss counts
+    # as "no improvement"
+    xs = rng.normal(size=(2, 4, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=(2, 4))]
+    class Probe(ReduceLROnPlateau):
+        metrics = []
+
+        def _compute_lr(self, metric):
+            Probe.metrics.append(metric)
+            return super()._compute_lr(metric)
+
+    sched = Probe(0.1, patience=0, factor=0.5, threshold=0.0)
+    tr.scheduler = sched
+    # two chunks in one epoch: chunk 0 must feed None×K (no loss exists
+    # yet); chunk 1 must feed the running loss ONCE then None — K-1
+    # duplicate metrics would count spurious "no improvement" plateau steps
+    tr._train_epoch_chunked(ts, [(xs, ys), (xs, ys)], KEY)
+    assert Probe.metrics[:2] == [None, None]
+    assert Probe.metrics[2] is not None and np.isfinite(Probe.metrics[2])
+    assert Probe.metrics[3] is None
+    assert np.isfinite(sched.best) and sched.bad_epochs == 0
+
+
 def test_pipeline_loss_grad_correct_through_log_softmax():
     """A model ENDING in log-softmax trained with logsoftmax_crossentropy via
     the pipeline must match single-device autodiff — guards against the
