@@ -13,6 +13,11 @@ from .augment import (
     brightness, contrast, cutout, gaussian_noise, horizontal_flip,
     normalization, random_crop, rotation, vertical_flip,
 )
+from .augment_device import DeviceAugment, DeviceAugmentBuilder
+from .device_dataset import (
+    DeviceDataset, make_resident_epoch, make_resident_eval,
+    resident_epoch, resident_eval,
+)
 
 __all__ = [
     "BaseDataLoader", "ArrayDataLoader", "one_hot",
@@ -22,4 +27,7 @@ __all__ = [
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",
+    "DeviceAugment", "DeviceAugmentBuilder",
+    "DeviceDataset", "make_resident_epoch", "make_resident_eval",
+    "resident_epoch", "resident_eval",
 ]

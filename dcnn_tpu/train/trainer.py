@@ -181,6 +181,14 @@ def _make_eval_step_cached(model: Sequential, loss_fn: Callable, _mode: str):
 
 def evaluate_classification(model, params, state, loss_fn, loader,
                             eval_step=None) -> Tuple[float, float]:
+    from ..data.device_dataset import DeviceDataset, resident_eval
+    if isinstance(loader, DeviceDataset):
+        # HBM-resident split: one device dispatch for the whole validation
+        # pass (padded batches; exact masking — see data/device_dataset.py)
+        ev = resident_eval(model, loss_fn, loader)
+        loss_sum, correct, n = ev(params, state, loader.x, loader.y,
+                                  scale=loader.scale)
+        return float(loss_sum) / n, int(correct) / n
     eval_step = eval_step if eval_step is not None else make_eval_step(model, loss_fn)
     total_loss, total_correct, total_n = 0.0, 0, 0
     for x, y in loader:
@@ -228,6 +236,9 @@ class Trainer:
 
     def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
                     epoch: int = 0) -> Tuple[TrainState, float, float]:
+        from ..data.device_dataset import DeviceDataset
+        if isinstance(loader, DeviceDataset):
+            return self._train_epoch_resident(ts, loader, rng, epoch)
         if self.multi_step is not None:
             return self._train_epoch_chunked(ts, loader, rng, epoch)
         total_loss, total_correct, total_n, batches = 0.0, 0, 0, 0
@@ -252,6 +263,34 @@ class Trainer:
                       f"acc {total_correct / total_n:.4f} "
                       f"({total_n / dt:.1f} samples/s)", flush=True)
         return ts, (total_loss / max(total_n, 1)), (total_correct / max(total_n, 1))
+
+    def _train_epoch_resident(self, ts: TrainState, ds, rng: jax.Array,
+                              epoch: int = 0) -> Tuple[TrainState, float, float]:
+        """HBM-resident epoch: ONE device dispatch runs shuffle + gather +
+        decode + augment + every train step (data/device_dataset.py). Zero
+        steady-state H2D; train accuracy is not materialized (NaN — validation
+        measures real accuracy), matching the chunked path's contract.
+        Per-batch LR schedules ship as a [steps] vector; metric-driven
+        schedulers see the previous epoch's mean train loss (per-epoch
+        granularity — mid-epoch losses never reach the host in this mode)."""
+        from ..data.device_dataset import resident_epoch
+        epoch_fn = resident_epoch(self.model, self.loss_fn, self.optimizer, ds,
+                                  self.config.num_microbatches)
+        k = ds.steps_per_epoch
+        if self.scheduler is not None and self.config.scheduler_step == "batch":
+            metric = self.history[-1]["train_loss"] if self.history else None
+            lrs = []
+            for si in range(k):
+                lrs.append(self.lr)
+                # one metric evaluation per epoch (cf. chunked path: one per
+                # chunk) — plateau patience is measured in epochs here
+                self.lr = self.scheduler.step(metric if si == 0 else None)
+            lr_arg = jnp.asarray(lrs, jnp.float32)
+        else:
+            lr_arg = self.lr
+        ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
+                                 jax.random.fold_in(rng, epoch), lr_arg)
+        return ts, float(mean_loss), float("nan")
 
     def _train_epoch_chunked(self, ts: TrainState, loader, rng: jax.Array,
                              epoch: int = 0) -> Tuple[TrainState, float, float]:
@@ -324,11 +363,25 @@ class Trainer:
                 # reference print cadence: print_profiling_summary per run,
                 # sequential.hpp:323-418).
                 self.profiler.maybe_clear_per_batch()
-                for x, y in train_loader:
+                from ..data.device_dataset import DeviceDataset as _DD
+                if isinstance(train_loader, _DD):
+                    # resident mode: profile one decoded batch off the staged
+                    # split (augmentation excluded — it's fused in-step there)
+                    b = train_loader.batch_size
+                    xb = (train_loader.x[:b].astype(jnp.float32)
+                          * train_loader.scale)
+                    yb = jax.nn.one_hot(train_loader.y[:b],
+                                        train_loader.num_classes,
+                                        dtype=jnp.float32)
+                    batches = [(xb, yb)]
+                else:
+                    batches = train_loader
+                for x, y in batches:
                     # LayerProfiler runs its own untimed warm pass per
                     # (model, shape, dtype, precision) before timing, so one
                     # profiled fwd/bwd here is steady-state.
-                    if self.multi_step is not None:
+                    if (self.multi_step is not None
+                            and not isinstance(train_loader, _DD)):
                         # chunked loader yields [K, B, ...]: profile one batch
                         x, y = x[0], y[0]
                     x = jnp.asarray(x)
