@@ -137,6 +137,10 @@ def make_resident_epoch(model, loss_fn: Callable, optimizer, *,
 
     def epoch(ts, x_all, y_all, rng, lr):
         n = x_all.shape[0]
+        if n < batch_size:
+            raise ValueError(
+                f"resident epoch needs at least one batch: split has {n} "
+                f"samples < batch_size {batch_size}")
         k = steps if steps is not None else n // batch_size
         kperm, kstep = jax.random.split(rng)
         # with steps > n//batch_size (multi-epoch dispatch), tile extra

@@ -184,10 +184,11 @@ def evaluate_classification(model, params, state, loss_fn, loader,
     from ..data.device_dataset import DeviceDataset, resident_eval
     if isinstance(loader, DeviceDataset):
         # HBM-resident split: one device dispatch for the whole validation
-        # pass (padded batches; exact masking — see data/device_dataset.py)
+        # pass (full batches + exact remainder — see data/device_dataset.py)
         ev = resident_eval(model, loss_fn, loader)
         loss_sum, correct, n = ev(params, state, loader.x, loader.y,
                                   scale=loader.scale)
+        n = int(n)  # jit canonicalizes to Array; history/snapshots need floats
         return float(loss_sum) / n, int(correct) / n
     eval_step = eval_step if eval_step is not None else make_eval_step(model, loss_fn)
     total_loss, total_correct, total_n = 0.0, 0, 0

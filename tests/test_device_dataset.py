@@ -126,9 +126,9 @@ def test_resident_epoch_lr_vector_and_multi_epoch_steps():
 # ------------------------------------------------------------ resident eval
 
 def test_resident_eval_matches_host_eval_with_padding():
-    """Padded whole-split eval == host loader eval (drop_last=False), exactly:
-    zero-one-hot padding rows contribute 0 loss and are masked from correct."""
-    x, y = _blob_data(n=37, seed=2)   # 37 % 8 != 0 → exercises padding
+    """Whole-split eval == host loader eval (drop_last=False), exactly:
+    full batches scan + a statically-shaped remainder batch, no padding."""
+    x, y = _blob_data(n=37, seed=2)   # 37 % 8 != 0 → exercises the remainder
     model = _small_model()
     opt = Adam(1e-3)
     ts = create_train_state(model, opt, jax.random.PRNGKey(0))
@@ -226,6 +226,40 @@ def test_trainer_fit_resident_end_to_end():
 
     assert trainer.history[-1]["val_acc"] >= 0.9
     assert trainer.history[-1]["train_loss"] < trainer.history[0]["train_loss"]
+
+
+def test_resident_epoch_rejects_sub_batch_split():
+    from dcnn_tpu.data.device_dataset import make_resident_epoch
+
+    x, y = _blob_data(n=4)
+    model = _small_model()
+    opt = SGD(0.05)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    epoch_fn = make_resident_epoch(model, softmax_cross_entropy, opt,
+                                   num_classes=4, batch_size=8)
+    with pytest.raises(ValueError, match="at least one batch"):
+        epoch_fn(ts, jnp.asarray(x), jnp.asarray(y.astype(np.int32)),
+                 jax.random.PRNGKey(1), 0.05)
+
+
+def test_trainer_resident_snapshot_roundtrip(tmp_path):
+    """Best-val snapshot save works with resident eval (metrics must be
+    Python floats for the JSON manifest — review r3 pass 2 finding #1)."""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.train.checkpoint import load_checkpoint
+
+    x, y = _blob_data(n=64, seed=1)
+    model = _small_model()
+    opt = Adam(2e-3)
+    cfg = TrainingConfig(learning_rate=2e-3, snapshot_dir=str(tmp_path))
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    ds = DeviceDataset(x, y, 4, batch_size=16)
+    trainer.fit(ts, ds, ds, epochs=2)
+    _, params, _, _, _, meta = load_checkpoint(
+        str(tmp_path / model.name))
+    assert isinstance(meta["val_acc"], float)
+    assert jax.tree_util.tree_leaves(params)
 
 
 def test_trainer_fit_resident_with_augment():
