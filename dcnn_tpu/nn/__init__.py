@@ -23,6 +23,7 @@ from .residual import ResidualBlock
 from .sequential import Sequential
 from .factory import LayerFactory, register_layer, layer_from_config
 from .builder import SequentialBuilder
+from .fold import fold_batchnorm
 
 __all__ = [
     "Layer", "ParameterizedLayer", "StatelessLayer",
@@ -31,4 +32,5 @@ __all__ = [
     "ActivationLayer", "ResidualBlock", "MultiHeadAttentionLayer",
     "Sequential", "SequentialBuilder",
     "LayerFactory", "register_layer", "layer_from_config",
+    "fold_batchnorm",
 ]

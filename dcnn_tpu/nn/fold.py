@@ -1,0 +1,106 @@
+"""Inference-time BatchNorm folding.
+
+Reference context: the reference evaluates BN in inference mode as a
+per-channel affine using running statistics
+(``batchnorm_layer.tpp`` inference path); it never folds that affine into the
+preceding convolution. Folding is the standard deployment transform: for a
+Conv/Dense layer followed immediately by BatchNorm,
+
+    y = BN(conv(x, W, b)) = conv(x, W * s) + (b - mu) * s + beta,
+    s = gamma / sqrt(running_var + eps)
+
+so the BN layer disappears entirely from the inference graph — one fewer
+normalize pass per BN layer and a shorter op chain for XLA to schedule.
+
+``fold_batchnorm`` walks a Sequential (recursing into ResidualBlock main and
+shortcut paths), folds every (Conv2D|Dense) -> BatchNorm adjacency, and
+returns a NEW (model, params, state) triple — the original objects are
+untouched. BN layers not preceded by a foldable layer (e.g. after pooling)
+are kept as-is. The transform is inference-only: the folded model has no
+batch statistics to update, so training it would silently skip BN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .factory import layer_from_config
+from .layers import BatchNormLayer, Conv2DLayer, DenseLayer
+from .residual import ResidualBlock
+from .sequential import Sequential
+
+
+def _bn_scale_shift(bn: BatchNormLayer, bn_params, bn_state):
+    rm = jnp.asarray(bn_state["running_mean"], jnp.float32)
+    rv = jnp.asarray(bn_state["running_var"], jnp.float32)
+    c = rm.shape[0]
+    gamma = jnp.asarray(bn_params.get("gamma", jnp.ones((c,))), jnp.float32)
+    beta = jnp.asarray(bn_params.get("beta", jnp.zeros((c,))), jnp.float32)
+    s = gamma / jnp.sqrt(rv + bn.epsilon)
+    return s, beta - rm * s
+
+
+def _fold_pair(layer, lp, bn: BatchNormLayer, bn_params, bn_state):
+    """Fold BN into the preceding conv/dense; returns (new_layer, new_params).
+    The folded layer always carries a bias (the BN shift lands there)."""
+    s, shift = _bn_scale_shift(bn, bn_params, bn_state)
+    w = jnp.asarray(lp["w"], jnp.float32)
+    scale = s.reshape((-1,) + (1,) * (w.ndim - 1))  # out axis leads for both
+    new_w = (w * scale).astype(lp["w"].dtype)
+    b = jnp.asarray(lp["b"], jnp.float32) if "b" in lp else jnp.zeros_like(s)
+    new_b = (b * s + shift).astype(new_w.dtype)
+    cfg = layer.get_config()
+    cfg["use_bias"] = True
+    new_layer = layer_from_config(cfg)
+    return new_layer, {"w": new_w, "b": new_b}
+
+
+def _fold_list(layers: Sequence, params: Sequence, state: Sequence
+               ) -> Tuple[List, List, List]:
+    out_l: List[Any] = []
+    out_p: List[Any] = []
+    out_s: List[Any] = []
+    i = 0
+    while i < len(layers):
+        layer, lp, ls = layers[i], params[i], state[i]
+        nxt = layers[i + 1] if i + 1 < len(layers) else None
+        if (isinstance(layer, (Conv2DLayer, DenseLayer))
+                and isinstance(nxt, BatchNormLayer)):
+            new_layer, new_p = _fold_pair(layer, lp, nxt,
+                                          params[i + 1], state[i + 1])
+            out_l.append(new_layer)
+            out_p.append(new_p)
+            out_s.append({})
+            i += 2
+            continue
+        if isinstance(layer, ResidualBlock):
+            ml, mp, ms = _fold_list(layer.layers, lp["main"], ls["main"])
+            sl, sp, ss = _fold_list(layer.shortcut, lp["shortcut"],
+                                    ls["shortcut"])
+            out_l.append(ResidualBlock(ml, sl, activation=layer.activation,
+                                       name=layer.name))
+            out_p.append({"main": tuple(mp), "shortcut": tuple(sp)})
+            out_s.append({"main": tuple(ms), "shortcut": tuple(ss)})
+            i += 1
+            continue
+        # unchanged layer: rebuild from config so the folded model shares no
+        # (mutable) layer objects with the original
+        out_l.append(layer_from_config(layer.get_config()))
+        out_p.append(lp)
+        out_s.append(ls)
+        i += 1
+    return out_l, out_p, out_s
+
+
+def fold_batchnorm(model: Sequential, params, state
+                   ) -> Tuple[Sequential, Any, Any]:
+    """Return (folded_model, folded_params, folded_state) with every
+    (Conv2D|Dense)->BatchNorm pair collapsed into the linear layer.
+    Inference-only (see module docstring); outputs match the original
+    eval-mode model to float tolerance."""
+    layers, new_p, new_s = _fold_list(model.layers, params, state)
+    folded = Sequential(layers, name=f"{model.name}_folded",
+                        input_shape=model.input_shape)
+    return folded, tuple(new_p), tuple(new_s)

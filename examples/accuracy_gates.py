@@ -203,8 +203,25 @@ def main():
                 f"| {r['gate']} | {r['model']} | {r['epochs']} | {r['val_acc']} "
                 f"| {r['target']} | {'yes' if r['passed'] else 'NO'} "
                 f"| {r['wall_clock_s']} | {r['device']} | {r['precision']} |")
-    with open(os.path.join(ROOT, "RESULTS.md"), "w") as f:
-        f.write("\n".join(md) + "\n")
+    # RESULTS.md also carries hand-written perf/microbench sections below the
+    # gates table — replace only the first (gates) section, preserve the rest
+    md_path = os.path.join(ROOT, "RESULTS.md")
+    tail = ""
+    if os.path.exists(md_path):
+        with open(md_path) as f:
+            content = f.read()
+        lines = content.split("\n")
+        if lines and lines[0].startswith("# Accuracy gates"):
+            # replace only the leading gates section
+            for i, line in enumerate(lines[1:], start=1):
+                if line.startswith("# "):
+                    tail = "\n" + "\n".join(lines[i:])
+                    break
+        else:
+            # file doesn't start with our section: preserve it wholesale
+            tail = "\n" + content
+    with open(md_path, "w") as f:
+        f.write("\n".join(md) + "\n" + tail)
     print(f"wrote RESULTS.md / RESULTS.json ({len(merged)} gates)")
 
 

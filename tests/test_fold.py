@@ -1,0 +1,119 @@
+"""Inference-time BatchNorm folding tests (nn/fold.py).
+
+The folded model must reproduce the original eval-mode outputs to float
+tolerance on models with realistic (non-identity) running statistics,
+including residual blocks and bias-less convolutions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.nn import BatchNormLayer, Sequential, SequentialBuilder, fold_batchnorm
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.train.trainer import create_train_state, make_train_step
+
+
+def _train_a_bit(model, n_steps=4, n_classes=10, bs=8):
+    """Run a few real train steps so BN running stats are non-trivial."""
+    opt = Adam(1e-2)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    rng = np.random.default_rng(0)
+    shape = (bs, *model.input_shape)
+    for i in range(n_steps):
+        x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        y = jnp.asarray(np.eye(n_classes, dtype=np.float32)[
+            rng.integers(0, n_classes, size=bs)])
+        ts, _, _ = step(ts, x, y, jax.random.fold_in(jax.random.PRNGKey(1), i),
+                        1e-2)
+    return ts
+
+
+def _check_fold(model, n_classes=10, bs=4, atol=2e-5):
+    ts = _train_a_bit(model, n_classes=n_classes)
+    folded, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+
+    x = jnp.asarray(np.random.default_rng(7).normal(
+        size=(bs, *model.input_shape)).astype(np.float32))
+    y0, _ = model.apply(ts.params, ts.state, x, training=False)
+    y1, _ = folded.apply(fp, fs, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=atol)
+    return folded, fp, fs
+
+
+def test_fold_conv_bn_chain():
+    model = (SequentialBuilder(name="cbn", data_format="NHWC")
+             .input((8, 8, 3))
+             .conv2d(16, 3, padding=1).batchnorm().activation("relu")
+             .conv2d(8, 3, padding=1, use_bias=False).batchnorm()
+             .activation("relu")
+             .flatten().dense(10)
+             .build())
+    folded, fp, fs = _check_fold(model)
+    assert not any(isinstance(l, BatchNormLayer) for l in folded.layers)
+    # bias-less conv gained the BN shift as a bias
+    assert "b" in fp[2]
+
+
+def test_fold_dense_bn():
+    model = (SequentialBuilder(name="dbn", data_format="NHWC")
+             .input((6, 6, 1))
+             .flatten().dense(32).batchnorm().activation("relu").dense(10)
+             .build())
+    folded, _, _ = _check_fold(model)
+    assert not any(isinstance(l, BatchNormLayer) for l in folded.layers)
+
+
+def test_fold_residual_recursion():
+    from dcnn_tpu.models import create_resnet9_cifar10
+
+    model = create_resnet9_cifar10("NHWC")
+    folded, fp, fs = _check_fold(model, bs=2, atol=5e-4)
+
+    def count_bn(layers):
+        n = 0
+        for l in layers:
+            if isinstance(l, BatchNormLayer):
+                n += 1
+            if hasattr(l, "layers") and hasattr(l, "shortcut"):
+                n += count_bn(l.layers) + count_bn(l.shortcut)
+        return n
+
+    assert count_bn(folded.layers) == 0
+
+
+def test_fold_keeps_unpaired_bn():
+    """BN after pooling has no foldable predecessor and must survive."""
+    model = (SequentialBuilder(name="ubn", data_format="NHWC")
+             .input((8, 8, 3))
+             .maxpool2d(2).batchnorm().flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    folded, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+    assert any(isinstance(l, BatchNormLayer) for l in folded.layers)
+    x = jnp.asarray(np.random.default_rng(7).normal(
+        size=(4, 8, 8, 3)).astype(np.float32))
+    y0, _ = model.apply(ts.params, ts.state, x, training=False)
+    y1, _ = folded.apply(fp, fs, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fold_does_not_mutate_original():
+    model = (SequentialBuilder(name="orig", data_format="NHWC")
+             .input((8, 8, 3))
+             .conv2d(4, 3, padding=1, use_bias=False).batchnorm()
+             .flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    w_before = np.asarray(ts.params[0]["w"]).copy()
+    n_layers = len(model.layers)
+    fold_batchnorm(model, ts.params, ts.state)
+    assert len(model.layers) == n_layers
+    np.testing.assert_array_equal(np.asarray(ts.params[0]["w"]), w_before)
+    assert not model.layers[0].use_bias

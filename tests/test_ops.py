@@ -124,6 +124,28 @@ def test_batch_norm_train_and_eval_vs_torch(rng):
     np.testing.assert_array_equal(np.asarray(m2), np.asarray(new_m))
 
 
+def test_batch_norm_one_pass_stats_stability(rng):
+    """The one-pass sum/sumsq statistics are centered on running_mean
+    (norm.py): with rm tracking the batch mean (steady state), variance stays
+    accurate even at |mean|/std ~ 1e5 where the raw E[x2]-mean^2 form loses
+    every significant bit."""
+    x = (1000.0 + 0.01 * rng.normal(size=(16, 8, 8, 4))).astype(np.float32)
+    c = 4
+    ones = np.ones(c, np.float32)
+    rm = np.full(c, 1000.0, np.float32)  # steady state: rm ~ batch mean
+    y, nm, nv = batch_norm(jnp.asarray(x), jnp.asarray(ones),
+                           jnp.asarray(np.zeros(c, np.float32)),
+                           jnp.asarray(rm), jnp.asarray(ones),
+                           training=True, momentum=1.0, data_format="NHWC")
+    n = x.size // c
+    true_var = x.reshape(-1, c).astype(np.float64).var(axis=0) * n / (n - 1)
+    np.testing.assert_allclose(np.asarray(nv), true_var, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(nm), x.reshape(-1, c).mean(axis=0),
+                               rtol=1e-6)
+    # normalized output is standard-scaled (eps-dominated floor accepted)
+    assert 0.5 < float(np.asarray(y).std()) <= 1.01
+
+
 def test_group_norm_vs_torch(rng):
     x = rng.normal(size=(2, 6, 4, 4)).astype(np.float32)
     gamma = rng.normal(size=(6,)).astype(np.float32)
