@@ -275,8 +275,8 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
 def main() -> None:
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from dcnn_tpu.utils import enable_compile_cache
+    enable_compile_cache()
 
     root = os.path.dirname(os.path.abspath(__file__))
     # 1024 measured best on v5e (22.4k img/s / 37% MFU vs 21.2k at 512,

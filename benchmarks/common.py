@@ -28,6 +28,11 @@ import numpy as np
 from dcnn_tpu.core.fence import hard_fence
 
 
+from dcnn_tpu.utils import enable_compile_cache
+
+enable_compile_cache()
+
+
 @dataclass
 class Result:
     """One benchmark row: name, timing, derived rate, correctness verdict."""

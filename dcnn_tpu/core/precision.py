@@ -34,6 +34,11 @@ _MODES = {
     "fast": lax.Precision.DEFAULT,
     "default": lax.Precision.DEFAULT,
     "bf16": lax.Precision.DEFAULT,
+    # fp64: the reference's double-kernel path (dgemm.cpp, dkernels.cpp).
+    # Enables jax_enable_x64, so layer init and Python-scalar promotion
+    # produce float64 and every op computes in double (TPUs emulate fp64 in
+    # software — this mode is for numerics auditing, not throughput).
+    "fp64": lax.Precision.HIGHEST,
 }
 
 _current = os.environ.get("DCNN_PRECISION", "parity").lower()
@@ -41,11 +46,21 @@ if _current not in _MODES:
     _current = "parity"
 
 
+def _sync_x64(mode: str) -> None:
+    jax.config.update("jax_enable_x64", mode == "fp64")
+
+
+if _current == "fp64":  # env-selected: enable x64 before any array exists
+    _sync_x64(_current)
+
+
 def set_precision(mode: str) -> None:
     global _current
     mode = mode.lower()
     if mode not in _MODES:
         raise ValueError(f"unknown precision mode {mode!r}; known: {sorted(_MODES)}")
+    if (mode == "fp64") != (_current == "fp64"):
+        _sync_x64(mode)
     _current = mode
 
 
@@ -60,7 +75,11 @@ def get_precision_mode() -> str:
 def get_compute_dtype() -> Optional[Any]:
     """Activation/param compute dtype for the current mode, or None when the
     mode computes in the storage dtype (parity/fast)."""
-    return jnp.bfloat16 if _current == "bf16" else None
+    if _current == "bf16":
+        return jnp.bfloat16
+    if _current == "fp64":
+        return jnp.float64
+    return None
 
 
 def precision_keyed_jit(f, **jit_kwargs):

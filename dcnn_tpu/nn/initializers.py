@@ -9,25 +9,34 @@ default Kaiming-uniform; ``conv2d_layer.tpp:71-85``,
 from __future__ import annotations
 
 import math
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
+def _default_dtype():
+    """Param storage dtype: float64 under the fp64 precision mode (the
+    reference's double-kernel path), float32 otherwise (bf16 mixed precision
+    keeps fp32 master params and casts at point of use)."""
+    from ..core.precision import get_precision_mode
+    return jnp.float64 if get_precision_mode() == "fp64" else jnp.float32
+
+
 def kaiming_uniform(key: jax.Array, shape: Sequence[int], fan_in: int,
-                    dtype=jnp.float32) -> jax.Array:
+                    dtype: Optional[jnp.dtype] = None) -> jax.Array:
     bound = 1.0 / math.sqrt(float(fan_in))
-    return jax.random.uniform(key, tuple(shape), dtype=dtype, minval=-bound, maxval=bound)
+    return jax.random.uniform(key, tuple(shape), dtype=dtype or _default_dtype(),
+                              minval=-bound, maxval=bound)
 
 
 def conv_fan_in(in_channels: int, kernel_hw: Tuple[int, int]) -> int:
     return in_channels * kernel_hw[0] * kernel_hw[1]
 
 
-def zeros(shape, dtype=jnp.float32) -> jax.Array:
-    return jnp.zeros(shape, dtype)
+def zeros(shape, dtype: Optional[jnp.dtype] = None) -> jax.Array:
+    return jnp.zeros(shape, dtype or _default_dtype())
 
 
-def ones(shape, dtype=jnp.float32) -> jax.Array:
-    return jnp.ones(shape, dtype)
+def ones(shape, dtype: Optional[jnp.dtype] = None) -> jax.Array:
+    return jnp.ones(shape, dtype or _default_dtype())

@@ -28,8 +28,12 @@ import jax.numpy as jnp
 
 
 def _f32(a: jax.Array) -> jax.Array:
+    """Upcast to at-least-fp32 (fp64 inputs stay fp64 — the fp64 precision
+    mode must not lose bits at the loss boundary)."""
     a = jnp.asarray(a)
-    return a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.floating) else a
+    if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jnp.float64:
+        return a.astype(jnp.float32)
+    return a
 
 
 def _loss_fp32(fn):

@@ -46,11 +46,13 @@ def batch_norm(
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
 
-    # Statistics always accumulate in fp32, whatever the activation dtype —
-    # with bf16 activations (mixed-precision mode) a bf16 mean/var over
-    # N*H*W elements would lose most of its mantissa. XLA fuses the upcast
-    # into the reduction, so no fp32 copy of x is materialized.
-    xf = x.astype(jnp.float32)
+    # Statistics always accumulate in at-least-fp32, whatever the activation
+    # dtype — with bf16 activations (mixed-precision mode) a bf16 mean/var
+    # over N*H*W elements would lose most of its mantissa; fp64 inputs (the
+    # fp64 mode) keep full double statistics. XLA fuses the upcast into the
+    # reduction, so no widened copy of x is materialized.
+    stat_dt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    xf = x.astype(stat_dt)
     if training:
         # ONE-pass statistics: sum and sum-of-squares reduce together, so XLA
         # emits a single multi-output reduction over x. The naive
@@ -77,7 +79,7 @@ def batch_norm(
         # finite) — the same regime cuDNN's single-pass BN accepts; steady
         # state matches the reference's stable kernel.
         n = x.size // x.shape[c_axis]
-        pivot = running_mean.astype(jnp.float32)
+        pivot = running_mean.astype(stat_dt)
         xs = xf - pivot.reshape(shape)
         s1 = jnp.sum(xs, axis=reduce_axes)
         s2 = jnp.sum(xs * xs, axis=reduce_axes)
@@ -88,13 +90,13 @@ def batch_norm(
         new_mean = ((1 - momentum) * running_mean + momentum * mean).astype(running_mean.dtype)
         new_var = ((1 - momentum) * running_var + momentum * unbiased).astype(running_var.dtype)
     else:
-        mean, var = (running_mean.astype(jnp.float32),
-                     running_var.astype(jnp.float32))
+        mean, var = (running_mean.astype(stat_dt),
+                     running_var.astype(stat_dt))
         new_mean, new_var = running_mean, running_var
 
     inv = jax.lax.rsqrt(var + eps)
     y = (xf - mean.reshape(shape)) * inv.reshape(shape)
-    y = y * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    y = y * gamma.astype(stat_dt).reshape(shape) + beta.astype(stat_dt).reshape(shape)
     return y.astype(x.dtype), new_mean, new_var
 
 
@@ -117,7 +119,8 @@ def group_norm(
     n, c, h, w = x.shape
     if c % num_groups != 0:
         raise ValueError(f"channels {c} not divisible by groups {num_groups}")
-    xg = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, h, w)
+    stat_dt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+    xg = x.astype(stat_dt).reshape(n, num_groups, c // num_groups, h, w)
     # GroupNorm keeps the stable two-pass mean/var: unlike BN there is no
     # independent pivot (running stats) to center the one-pass sum/sumsq on,
     # and an x-derived pivot forces XLA to materialize the centered tensor
@@ -126,7 +129,7 @@ def group_norm(
     var = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
     y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(n, c, h, w)
     if gamma is not None:
-        y = y * gamma.astype(jnp.float32).reshape(1, c, 1, 1)
+        y = y * gamma.astype(stat_dt).reshape(1, c, 1, 1)
     if beta is not None:
-        y = y + beta.astype(jnp.float32).reshape(1, c, 1, 1)
+        y = y + beta.astype(stat_dt).reshape(1, c, 1, 1)
     return y.astype(x.dtype)

@@ -235,7 +235,8 @@ class HeteroCompiledPipeline:
     """
 
     def __init__(self, model, num_stages: int, num_microbatches: int,
-                 mesh: Mesh, partitioner=None, remat: bool = True):
+                 mesh: Mesh, partitioner=None, remat: bool = True,
+                 wire_dtype=None):
         from .partitioner import NaivePartitioner
 
         if model.input_shape is None:
@@ -245,6 +246,12 @@ class HeteroCompiledPipeline:
         self.num_microbatches = num_microbatches
         self.mesh = mesh
         self.remat = remat
+        # dtype of the inter-stage rotate buffer (the ppermute payload).
+        # fp32 default preserves exact parity with the host-driven engine;
+        # bf16 halves ICI wire bytes at one rounding step per stage boundary
+        # — the same quantization the bf16 mixed-precision mode applies at
+        # every op, so training parity holds to bf16 tolerance.
+        self.wire_dtype = wire_dtype or jnp.float32
         self.partitions = (partitioner or NaivePartitioner()).get_partitions(
             model, num_stages)
         self.stage_models = model.split(self.partitions)
@@ -314,6 +321,7 @@ class HeteroCompiledPipeline:
         unravel_p, unravel_s = self._unravel_p, self._unravel_s
         stage_models = self.stage_models
         Lp, Ls = self.Lp, self.Ls
+        wire = self.wire_dtype
         # widest per-sample activation crossing any stage boundary (stage-0
         # input or any stage's output) — the flat rotate-buffer width
         max_elems = max([_prod(in_shapes[0])] + [_prod(s) for s in out_shapes])
@@ -330,12 +338,14 @@ class HeteroCompiledPipeline:
                 def branch(fpv, fsv, buf, key):
                     p = unravel_p[i](fpv[:psizes[i]])
                     s = unravel_s[i](fsv[:ssizes[i]])
+                    # wire dtype -> fp32 at unpack (the stage computes in its
+                    # own precision policy; bf16 wire only quantizes the hop)
                     x = buf[: mb * _prod(in_shapes[i])].reshape(
-                        mb, *in_shapes[i])
+                        mb, *in_shapes[i]).astype(jnp.float32)
                     y, s_new = stage_models[i].apply(
                         p, s, x, training=True, rng=key)
                     fs_new, _ = ravel_pytree(s_new)
-                    out = jnp.pad(y.reshape(-1).astype(jnp.float32),
+                    out = jnp.pad(y.reshape(-1).astype(wire),
                                   (0, LactTot - mb * _prod(out_shapes[i])))
                     return out, jnp.pad(fs_new.astype(jnp.float32),
                                         (0, Ls - fs_new.size))
@@ -368,8 +378,8 @@ class HeteroCompiledPipeline:
                     [(i, (i + 1) % S) for i in range(S)])
                 return (buf, fsv, outputs), None
 
-            buf0 = jnp.zeros((LactTot,), jnp.float32)
-            outputs0 = jnp.zeros((M, LactTot), jnp.float32)
+            buf0 = jnp.zeros((LactTot,), wire)
+            outputs0 = jnp.zeros((M, LactTot), wire)
             (buf, fsv, outputs), _ = jax.lax.scan(
                 tick, (buf0, fs0, outputs0), jnp.arange(total_ticks))
             outputs = jax.lax.psum(
@@ -389,14 +399,17 @@ class HeteroCompiledPipeline:
             outputs, new_state = smapped(flat_params, flat_state, mbs_flat, rng)
             mb = mbs_flat.shape[1] // max_elems
             logits = outputs[:, : mb * out_elems].reshape(
-                M, mb, *out_shapes[-1])
+                M, mb, *out_shapes[-1]).astype(jnp.float32)
             losses = jax.vmap(loss_fn)(logits, mb_y)
             return jnp.mean(losses), (logits, new_state)
 
         def step(flat_params, opt_state, flat_state, mb_x, mb_y, rng, lr):
             mb = mb_x.shape[1]
+            # `wire` (captured at build time), NOT self.wire_dtype: a later
+            # attribute change must not desync the input cast from the
+            # already-compiled scan carry
             mbs_flat = jnp.pad(
-                mb_x.reshape(M, -1).astype(jnp.float32),
+                mb_x.reshape(M, -1).astype(wire),
                 ((0, 0), (0, mb * max_elems - mb * _prod(in_shapes[0]))))
             (loss, (logits, new_state)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(flat_params, flat_state, mbs_flat,

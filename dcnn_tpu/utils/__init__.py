@@ -1,5 +1,6 @@
 """Utilities: env config, hardware info, compression (reference ``include/utils/``)."""
 
+from .compile_cache import enable_compile_cache
 from .env import load_env_file, get_env
 
-__all__ = ["load_env_file", "get_env"]
+__all__ = ["load_env_file", "get_env", "enable_compile_cache"]

@@ -1,0 +1,23 @@
+"""Shared persistent-XLA-compile-cache setup.
+
+One canonical helper instead of per-entry-point copies (tests/conftest.py,
+bench.py, benchmarks/common.py): multi-stage scans and big train steps cost
+minutes to compile on a 1-core host, so every harness wants cache hits on
+rerun — and the thresholds must not drift between call sites.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
+                         min_compile_secs: float = 0.5) -> None:
+    """Idempotent: safe to call from any entry point, any number of times."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DCNN_COMPILE_CACHE", cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

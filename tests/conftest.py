@@ -26,9 +26,9 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: recompiling every jitted step on a 1-core host
 # dominates test time; the cache makes reruns near-instant.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+from dcnn_tpu.utils import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -268,3 +268,36 @@ def test_hetero_runs_flagship_resnet18():
         jnp.float32(0.01))
     assert np.isfinite(float(loss))
     assert logits.shape == (M, 2, 200)
+
+
+def test_hetero_bf16_wire_parity(hetero_setup):
+    """bf16 rotate buffers (wire_dtype): loss tracks the fp32-wire engine to
+    bf16 tolerance and training still converges — the ICI payload halves."""
+    pipe, S, M = hetero_setup
+    mesh = pipe.mesh
+    model = _hetero_model()
+    pipe16 = HeteroCompiledPipeline(model, S, M, mesh,
+                                    wire_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 3, 8, 8)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, size=8)]
+    key = jax.random.PRNGKey(3)
+    mb_x = jnp.asarray(x.reshape(M, 4, 3, 8, 8))
+    mb_y = jnp.asarray(y.reshape(M, 4, 5))
+
+    losses = {}
+    for name, p in (("fp32", pipe), ("bf16", pipe16)):
+        opt = SGD(0.05)
+        fp, fs = p.init(key)
+        opt_state = opt.init(fp)
+        step = p.make_train_step(softmax_cross_entropy, opt)
+        ls = []
+        for i in range(4):
+            fp, opt_state, fs, loss, _ = step(
+                fp, opt_state, fs, mb_x, mb_y, jax.random.PRNGKey(9),
+                jnp.float32(0.05))
+            ls.append(float(loss))
+        losses[name] = ls
+
+    assert abs(losses["bf16"][0] - losses["fp32"][0]) < 0.05
+    assert losses["bf16"][-1] < losses["bf16"][0]

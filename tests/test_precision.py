@@ -172,3 +172,64 @@ def test_multi_step_matches_sequential_steps():
     for a, b in zip(jax.tree_util.tree_leaves(ts_a.state),
                     jax.tree_util.tree_leaves(ts_b.state)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fp64_mode_subprocess():
+    """DCNN_PRECISION=fp64 (the reference's double-kernel path,
+    src/math/cpu/dgemm.cpp): params init as float64, a train step runs in
+    double, and dense forward matches numpy float64 to 1e-12. Runs in a
+    subprocess because jax_enable_x64 is process-global."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import os
+os.environ["DCNN_PRECISION"] = "fp64"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import dcnn_tpu  # applies platform override
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from dcnn_tpu.core.precision import get_compute_dtype, get_precision_mode
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.train.trainer import create_train_state, make_train_step
+
+assert get_precision_mode() == "fp64"
+assert get_compute_dtype() == jnp.float64
+assert jax.config.jax_enable_x64
+
+model = (SequentialBuilder(name="fp64_mlp", data_format="NHWC")
+         .input((6,)).dense(8).activation("relu").dense(4).build())
+opt = SGD(0.1)
+ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+for leaf in jax.tree_util.tree_leaves(ts.params):
+    assert leaf.dtype == jnp.float64, leaf.dtype
+
+x = jnp.asarray(np.random.default_rng(0).normal(size=(5, 6)))
+assert x.dtype == jnp.float64
+y, _ = model.apply(ts.params, ts.state, x, training=False)
+assert y.dtype == jnp.float64
+
+# forward parity vs numpy float64 (weights stored (out, in))
+h = np.asarray(x, np.float64)
+h = np.maximum(h @ np.asarray(ts.params[0]["w"]).T + np.asarray(ts.params[0]["b"]), 0.0)
+ref = h @ np.asarray(ts.params[2]["w"]).T + np.asarray(ts.params[2]["b"])
+np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-12, atol=1e-12)
+
+# one double train step: finite loss, params stay float64
+step = make_train_step(model, softmax_cross_entropy, opt)
+targets = jnp.asarray(np.eye(4)[np.random.default_rng(1).integers(0, 4, 5)])
+ts, loss, _ = step(ts, x, targets, jax.random.PRNGKey(1), 0.1)
+assert np.isfinite(float(loss))
+for leaf in jax.tree_util.tree_leaves(ts.params):
+    assert leaf.dtype == jnp.float64
+print("FP64-OK")
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "FP64-OK" in out.stdout, (out.stdout, out.stderr[-2000:])
