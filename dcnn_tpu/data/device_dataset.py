@@ -36,6 +36,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..ops.losses import upcast_logits
+
 
 class DeviceDataset:
     """A classification split staged into device memory once.
@@ -188,7 +190,7 @@ def make_resident_eval(model, loss_fn: Callable, *, num_classes: int,
     def batch_metrics(params, state, xb_raw, yb, scale):
         xb = _decode(xb_raw, scale, cdt)
         logits, _ = model.apply(params, state, xb, training=False)
-        logits = logits.astype(jnp.float32)
+        logits = upcast_logits(logits)
         onehot = jax.nn.one_hot(yb, num_classes, dtype=jnp.float32)
         loss = loss_fn(logits, onehot)
         hit = jnp.sum(jnp.argmax(logits, axis=-1) == yb)
@@ -218,6 +220,112 @@ def make_resident_eval(model, loss_fn: Callable, *, num_classes: int,
         return loss_sum, correct, n
 
     return jax.jit(evaluate)
+
+
+def make_resident_epoch_dp(model, loss_fn: Callable, optimizer, *,
+                           num_classes: int, batch_size: int, mesh,
+                           augment: Optional[Callable] = None,
+                           scale: float = 1.0 / 255.0):
+    """Data-parallel resident epochs: the dataset lives SHARDED across the
+    mesh's ``data`` axis (each device holds ``N/D`` samples in its HBM), and
+    one dispatch runs the whole epoch on every device — local shuffle +
+    gather + decode + augment per shard, gradient ``pmean`` over ICI, and a
+    replicated optimizer update.
+
+    This is the distributed-sampler pattern (each rank permutes its own
+    partition per epoch) fused into the device program: zero steady-state
+    H2D *and* zero per-step host involvement across the whole mesh. The
+    aggregate dataset capacity scales with the mesh (D × per-chip HBM) —
+    Tiny-ImageNet-scale splits stay resident on a single v5e-8.
+
+    ``batch_size`` is GLOBAL (must divide by mesh data size; each device
+    computes batch_size/D samples per step). BN semantics: running stats are
+    computed per shard and pmean-averaged each step — the same
+    class of approximation as the reference's per-microbatch BN
+    (SURVEY.md §7 hard part 4), where normalization uses sub-batch
+    statistics. Loss/grad scaling is exact (equal shards → pmean of local
+    means is the global mean).
+
+    Returns jitted ``epoch(ts, x_shard, y_shard, rng, lr) -> (ts, loss)``
+    where x_shard/y_shard are sharded [N, ...]/[N] arrays (use
+    :func:`stage_sharded`). ``ts`` is replicated.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.mesh import DATA_AXIS
+    from ..core.precision import get_compute_dtype
+    from ..train.trainer import make_train_step
+
+    d = mesh.shape[DATA_AXIS]
+    if batch_size % d != 0:
+        raise ValueError(f"global batch {batch_size} % data size {d} != 0")
+    local_batch = batch_size // d
+    cdt = get_compute_dtype()
+    # the canonical train step with in-body pmean (grads/loss/state) — the
+    # DP epoch shares every fwd/bwd/update detail with the single-device path
+    base = make_train_step(model, loss_fn, optimizer, jit=False,
+                           reduce_axis=DATA_AXIS)
+
+    def per_device(ts, x_local, y_local, rng, lr):
+        n_local = x_local.shape[0]
+        k = n_local // local_batch
+        if k == 0:
+            raise ValueError(
+                f"resident DP epoch needs at least one local batch: shard "
+                f"has {n_local} samples < local batch {local_batch} "
+                f"(global {batch_size} over {d} devices)")
+        dev = jax.lax.axis_index(DATA_AXIS)
+        kperm, kstep = jax.random.split(rng)
+        perm = jax.random.permutation(
+            jax.random.fold_in(kperm, dev), n_local)
+        idx = perm[:k * local_batch].reshape(k, local_batch)
+        lrs = jnp.broadcast_to(jnp.asarray(lr, jnp.float32), (k,))
+
+        def body(carry, scan_in):
+            bidx, i, lr_i = scan_in
+            xb = _decode(x_local[bidx], scale, cdt)
+            key = jax.random.fold_in(jax.random.fold_in(kstep, i), dev)
+            if augment is not None:
+                xb = augment(xb, jax.random.fold_in(key, 0x0A6))
+            yb = jax.nn.one_hot(y_local[bidx], num_classes,
+                                dtype=jnp.float32)
+            new_ts, loss, _ = base(carry, xb, yb, key, lr_i)
+            return new_ts, loss
+
+        ts, losses = jax.lax.scan(body, ts, (idx, jnp.arange(k), lrs))
+        return ts, jnp.mean(losses)
+
+    smapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False)
+
+    def epoch(ts, x_shard, y_shard, rng, lr):
+        return smapped(ts, x_shard, y_shard, rng,
+                       jnp.asarray(lr, jnp.float32))
+
+    return jax.jit(epoch, donate_argnums=(0,))
+
+
+def stage_sharded(x, y, mesh):
+    """Stage a split sharded over the mesh's data axis (sample dim): each
+    device holds N/D contiguous samples in its own HBM. Trims the remainder
+    so shards are equal."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..core.mesh import DATA_AXIS
+
+    d = mesh.shape[DATA_AXIS]
+    n = (len(x) // d) * d
+    x, y = np.asarray(x)[:n], np.asarray(y)[:n]
+    if y.ndim == 2:
+        y = y.argmax(axis=-1)
+    xs = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS)))
+    ys = jax.device_put(y.astype(np.int32), NamedSharding(mesh, P(DATA_AXIS)))
+    return xs, ys
 
 
 @functools.lru_cache(maxsize=32)

@@ -36,6 +36,13 @@ def _f32(a: jax.Array) -> jax.Array:
     return a
 
 
+def upcast_logits(logits: jax.Array) -> jax.Array:
+    """Model outputs -> loss/metric dtype: fp32 for fp32/bf16 activations,
+    fp64 preserved (the fp64 mode must not quantize the loss boundary).
+    The canonical cast for every trainer/eval path."""
+    return _f32(logits)
+
+
 def _loss_fp32(fn):
     """Loss math always runs in fp32: under the bf16 mixed-precision mode
     (core/precision.py) models emit bf16 predictions, and logsumexp/softmax

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from ..core.config import ProfilerType, TrainingConfig
 from ..nn.sequential import Sequential
-from ..ops.losses import get_loss
+from ..ops.losses import get_loss, upcast_logits
 from ..ops.metrics import correct_count
 from ..optim.optimizers import Optimizer
 from ..optim.schedulers import Scheduler
@@ -64,19 +64,26 @@ def create_train_state(model: Sequential, optimizer: Optimizer, key: jax.Array,
 
 def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
                     num_microbatches: int = 1, donate: bool = True,
-                    jit: bool = True):
+                    jit: bool = True, reduce_axis: Optional[str] = None):
     """Returns jitted ``step(ts, x, y, rng, lr) -> (ts, loss, logits)``.
 
     With ``num_microbatches > 1`` the batch is split on the leading axis and
     grads are accumulated with ``lax.scan`` (the single-jit analog of the
-    reference's microbatch streaming, tensor_ops.hpp:193-225)."""
+    reference's microbatch streaming, tensor_ops.hpp:193-225).
+
+    ``reduce_axis``: name of a mapped mesh axis (shard_map/pmap body) to
+    ``pmean`` grads, loss, and the updated layer state over before the
+    optimizer update — the canonical data-parallel step; every DP wrapper
+    reuses this instead of reimplementing fwd/bwd/update. Logits stay local
+    to the shard."""
 
     def forward_loss(params, state, x, y, rng):
         logits, new_state = model.apply(params, state, x, training=True, rng=rng)
-        # The repo losses upcast internally (ops/losses._loss_fp32 is the fp32
-        # boundary); this cast covers *custom* loss_fns and fixes the dtype of
-        # the logits handed back to callers (metrics consume fp32).
-        logits = logits.astype(jnp.float32)
+        # The repo losses upcast internally (ops/losses._loss_fp32 is the
+        # boundary); this cast covers *custom* loss_fns and fixes the dtype
+        # of the logits handed back to callers. fp64 stays fp64 (the fp64
+        # precision mode must not quantize the loss/cotangent boundary).
+        logits = upcast_logits(logits)
         return loss_fn(logits, y), (logits, new_state)
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
@@ -115,6 +122,12 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
             loss = loss_sum / num_microbatches
             logits = logits_all.reshape(x.shape[0], -1)
 
+        if reduce_axis is not None:
+            grads = jax.lax.pmean(grads, reduce_axis)
+            loss = jax.lax.pmean(loss, reduce_axis)
+            # per-shard batch statistics, mesh-averaged (EMA is linear, so
+            # this equals an EMA of shard-mean statistics)
+            new_state = jax.lax.pmean(new_state, reduce_axis)
         new_params, new_opt = optimizer.update(grads, ts.opt_state, ts.params, lr)
         return (TrainState(new_params, new_state, new_opt, ts.step + 1), loss, logits)
 
@@ -173,7 +186,7 @@ def _make_eval_step_cached(model: Sequential, loss_fn: Callable, _mode: str):
     @jax.jit
     def eval_step(params, state, x, y):
         logits, _ = model.apply(params, state, x, training=False)
-        logits = logits.astype(jnp.float32)
+        logits = upcast_logits(logits)
         return loss_fn(logits, y), correct_count(logits, y)
 
     return eval_step

@@ -222,8 +222,11 @@ np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-12, atol=1e-12)
 # one double train step: finite loss, params stay float64
 step = make_train_step(model, softmax_cross_entropy, opt)
 targets = jnp.asarray(np.eye(4)[np.random.default_rng(1).integers(0, 4, 5)])
-ts, loss, _ = step(ts, x, targets, jax.random.PRNGKey(1), 0.1)
+ts, loss, logits = step(ts, x, targets, jax.random.PRNGKey(1), 0.1)
 assert np.isfinite(float(loss))
+# the loss boundary must not quantize doubles (upcast_logits passthrough)
+assert logits.dtype == jnp.float64, logits.dtype
+assert loss.dtype == jnp.float64, loss.dtype
 for leaf in jax.tree_util.tree_leaves(ts.params):
     assert leaf.dtype == jnp.float64
 print("FP64-OK")
