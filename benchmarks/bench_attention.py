@@ -61,8 +61,8 @@ def run() -> dict:
             results.append(Result(f"attn_fwd_{name}_S{s}", dt,
                                   flops / dt / 1e12, "TFLOP/s", ok, err))
 
-        # forward+backward (grad wrt q,k,v) — flash's VJP is a recompute
-        # through the blockwise path; this measures what training pays
+        # forward+backward (grad wrt q,k,v) — flash's VJP runs the Pallas
+        # dq/dk/dv kernels (round 3); this measures what training pays
         grads = {
             name: jax.jit(jax.grad(lambda a, b_, c, f=fn: f(a, b_, c).sum(),
                                    argnums=(0, 1, 2)))

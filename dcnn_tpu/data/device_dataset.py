@@ -81,6 +81,11 @@ class DeviceDataset:
     def steps_per_epoch(self) -> int:
         return self.num_samples // self.batch_size
 
+    def __len__(self) -> int:
+        """Batches per epoch — loader-compatible (schedulers size per-batch
+        cycles with len(train_loader))."""
+        return self.steps_per_epoch
+
     @property
     def hbm_bytes(self) -> int:
         return self.x.nbytes + self.y.nbytes
@@ -313,8 +318,6 @@ def stage_sharded(x, y, mesh):
     """Stage a split sharded over the mesh's data axis (sample dim): each
     device holds N/D contiguous samples in its own HBM. Trims the remainder
     so shards are equal."""
-    import numpy as np
-
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.mesh import DATA_AXIS
 

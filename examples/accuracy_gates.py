@@ -90,16 +90,26 @@ def _try_download(names):
     with the skip message; a networked driver environment flips the gate to
     a real run automatically (VERDICT r2 #1).
 
-    A 5s TCP probe runs first so hosts that BLACKHOLE egress (drop, not
-    reject) don't stall each gate for the downloader's per-file 120s
-    timeouts."""
+    A 5s TCP probe of the host(s) actually serving the requested datasets
+    runs first, so hosts that BLACKHOLE egress (drop, not reject) don't
+    stall each gate for the downloader's per-file 120s timeouts."""
     import socket
     import subprocess
-    try:
-        socket.create_connection(
-            ("ossci-datasets.s3.amazonaws.com", 443), timeout=5).close()
-    except OSError:
-        return False
+    from urllib.parse import urlparse
+
+    from dcnn_tpu.data import download as dl
+
+    hosts = {"mnist": dl.MNIST_BASE, "cifar10": dl.CIFAR10_URL,
+             "cifar100": dl.CIFAR100_URL,
+             "tiny_imagenet": dl.TINY_IMAGENET_URL, "uji": dl.UJI_URL}
+    for name in names:
+        url = urlparse(hosts.get(name, dl.MNIST_BASE))
+        try:
+            socket.create_connection(
+                (url.hostname, url.port or (443 if url.scheme == "https"
+                                            else 80)), timeout=5).close()
+        except OSError:
+            return False
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "dcnn_tpu.data.download",

@@ -4,7 +4,7 @@ snapshot to model_snapshots/. Falls back to synthetic data when the dataset
 is absent (fetch with ``python -m dcnn_tpu.data.download --root data cifar100``).
 """
 
-from common import loader_or_synthetic, setup, with_prefetch
+from common import loader_or_synthetic, prepare_input, setup
 
 from dcnn_tpu.data import CIFAR100DataLoader
 from dcnn_tpu.models import create_cnn_cifar100
@@ -27,7 +27,9 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 100, cfg)
-    train_loader = with_prefetch(train_loader, cfg)
+    # RESIDENT=1 stages the split to HBM (epoch-in-one-dispatch)
+    train_loader, val_loader = prepare_input(
+        train_loader, val_loader, 100, cfg)
     model = create_cnn_cifar100()
     print(model.summary())
     # the reference pairs raw logits with its epsilon-clamped plain

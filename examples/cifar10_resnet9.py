@@ -1,7 +1,7 @@
 """CIFAR-10 ResNet-9 trainer (reference ``examples/cifar10_resnet9.cpp``)
 with the reference's augmentation recipe (random crop + hflip + cutout)."""
 
-from common import loader_or_synthetic, setup, with_prefetch
+from common import loader_or_synthetic, prepare_input, setup
 
 from dcnn_tpu.data import AugmentationBuilder, CIFAR10DataLoader
 from dcnn_tpu.models import create_resnet9_cifar10
@@ -30,7 +30,13 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 10, cfg)
-    train_loader = with_prefetch(train_loader, cfg)
+    # RESIDENT=1 stages the split to HBM (epoch-in-one-dispatch) with the
+    # same crop/hflip/cutout recipe rebuilt as on-device ops
+    from dcnn_tpu.data import DeviceAugmentBuilder
+    dev_aug = (DeviceAugmentBuilder("NCHW")
+               .random_crop(4).horizontal_flip(0.5).cutout(8, 0.5).build())
+    train_loader, val_loader = prepare_input(
+        train_loader, val_loader, 10, cfg, device_augment=dev_aug)
     model = create_resnet9_cifar10()
     print(model.summary())
     # scheduler cadence follows cfg.scheduler_step: per-epoch (default) sizes

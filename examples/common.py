@@ -33,6 +33,33 @@ def with_prefetch(loader, cfg):
                           stage_batches=max(cfg.steps_per_dispatch, 1))
 
 
+def prepare_input(train_loader, val_loader, num_classes, cfg,
+                  device_augment=None):
+    """Input-pipeline selection for the example trainers.
+
+    RESIDENT=1 stages both splits into device memory (``DeviceDataset``) so
+    the Trainer runs each epoch as ONE device dispatch — the fastest path
+    whenever the dataset fits HBM (measured feed_efficiency ~1.0; the digits
+    gate's wall-clock dropped 5× switching over). ``device_augment`` is the
+    on-device augmentation recipe (host loaders' numpy hooks don't transfer
+    — rebuild with ``DeviceAugmentBuilder``).
+
+    Otherwise the train loader is wrapped in the prefetching host pipeline
+    (background batch prep + H2D overlap, chunked staging when
+    cfg.steps_per_dispatch > 1).
+    """
+    if get_env("RESIDENT", "0") == "1":
+        from dcnn_tpu.data import DeviceDataset
+
+        train = DeviceDataset.from_loader(train_loader, num_classes,
+                                          augment=device_augment)
+        val = DeviceDataset.from_loader(val_loader, num_classes)
+        print(f"input: HBM-resident ({train.hbm_bytes / 1e6:.0f} MB train + "
+              f"{val.hbm_bytes / 1e6:.0f} MB val staged to device)")
+        return train, val
+    return with_prefetch(train_loader, cfg), val_loader
+
+
 def loader_or_synthetic(make_real, image_shape, num_classes, cfg,
                         n_train=2048, n_val=512):
     """Use the real dataset if its path exists, else synthetic data so every

@@ -5,7 +5,7 @@ TrainingConfig vars (EPOCHS, BATCH_SIZE, …) honored. Falls back to synthetic
 data when the dataset is absent.
 """
 
-from common import loader_or_synthetic, setup, with_prefetch
+from common import loader_or_synthetic, prepare_input, setup
 
 from dcnn_tpu.data import MNISTDataLoader
 from dcnn_tpu.models import create_mnist_trainer
@@ -27,7 +27,9 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (1, 28, 28), 10, cfg)
-    train_loader = with_prefetch(train_loader, cfg)
+    # RESIDENT=1 stages the split to HBM (epoch-in-one-dispatch)
+    train_loader, val_loader = prepare_input(
+        train_loader, val_loader, 10, cfg)
     model = create_mnist_trainer()
     print(model.summary())
     train_classification_model(model, Adam(cfg.learning_rate),

@@ -2,9 +2,10 @@
 / ``resnet34`` / ``resnet50``). Pick the depth with MODEL=resnet18|resnet34|
 resnet50|resnet9|cnn (env), dataset root with TINY_IMAGENET_DIR."""
 
-from common import loader_or_synthetic, setup, with_prefetch
+from common import loader_or_synthetic, prepare_input, setup
 
-from dcnn_tpu.data import AugmentationBuilder, TinyImageNetDataLoader
+from dcnn_tpu.data import (AugmentationBuilder, DeviceAugmentBuilder,
+                           TinyImageNetDataLoader)
 from dcnn_tpu.models import create_model
 from dcnn_tpu.optim import AdamW, WarmupCosineAnnealing
 from dcnn_tpu.train import train_classification_model
@@ -31,7 +32,12 @@ def main():
         return train, val
 
     train_loader, val_loader = loader_or_synthetic(real, (3, 64, 64), 200, cfg)
-    train_loader = with_prefetch(train_loader, cfg)
+    # RESIDENT=1: stage the whole split to HBM (~1.2 GB uint8) and run each
+    # epoch in one dispatch; same crop/flip recipe, on device
+    dev_aug = (DeviceAugmentBuilder("NCHW")
+               .random_crop(4).horizontal_flip(0.5).build())
+    train_loader, val_loader = prepare_input(train_loader, val_loader, 200,
+                                             cfg, device_augment=dev_aug)
     model = create_model(model_name)
     print(model.summary())
     sched = WarmupCosineAnnealing(cfg.learning_rate, warmup_steps=2,
