@@ -15,8 +15,9 @@ from .augment import (
 )
 from .augment_device import DeviceAugment, DeviceAugmentBuilder
 from .device_dataset import (
-    DeviceDataset, make_resident_epoch, make_resident_epoch_dp,
-    make_resident_eval, resident_epoch, resident_eval, stage_sharded,
+    DeviceDataset, ShardedDeviceDataset, make_resident_epoch,
+    make_resident_epoch_dp, make_resident_eval, resident_epoch,
+    resident_epoch_dp, resident_eval, stage_sharded,
 )
 
 __all__ = [
@@ -28,6 +29,7 @@ __all__ = [
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",
     "DeviceAugment", "DeviceAugmentBuilder",
-    "DeviceDataset", "make_resident_epoch", "make_resident_epoch_dp",
-    "make_resident_eval", "resident_epoch", "resident_eval", "stage_sharded",
+    "DeviceDataset", "ShardedDeviceDataset", "make_resident_epoch",
+    "make_resident_epoch_dp", "make_resident_eval", "resident_epoch",
+    "resident_epoch_dp", "resident_eval", "stage_sharded",
 ]

@@ -230,7 +230,8 @@ def make_resident_eval(model, loss_fn: Callable, *, num_classes: int,
 def make_resident_epoch_dp(model, loss_fn: Callable, optimizer, *,
                            num_classes: int, batch_size: int, mesh,
                            augment: Optional[Callable] = None,
-                           scale: float = 1.0 / 255.0):
+                           scale: float = 1.0 / 255.0,
+                           num_microbatches: int = 1):
     """Data-parallel resident epochs: the dataset lives SHARDED across the
     mesh's ``data`` axis (each device holds ``N/D`` samples in its HBM), and
     one dispatch runs the whole epoch on every device — local shuffle +
@@ -270,6 +271,7 @@ def make_resident_epoch_dp(model, loss_fn: Callable, optimizer, *,
     # the canonical train step with in-body pmean (grads/loss/state) — the
     # DP epoch shares every fwd/bwd/update detail with the single-device path
     base = make_train_step(model, loss_fn, optimizer, jit=False,
+                           num_microbatches=num_microbatches,
                            reduce_axis=DATA_AXIS)
 
     def per_device(ts, x_local, y_local, rng, lr):
@@ -312,6 +314,71 @@ def make_resident_epoch_dp(model, loss_fn: Callable, optimizer, *,
                        jnp.asarray(lr, jnp.float32))
 
     return jax.jit(epoch, donate_argnums=(0,))
+
+
+class ShardedDeviceDataset:
+    """A split staged SHARDED over a mesh's data axis for
+    :func:`make_resident_epoch_dp` — the Trainer routes it like a
+    ``DeviceDataset`` but runs the data-parallel resident epoch (one dispatch
+    per epoch on every device, grad pmean over ICI).
+
+    ``batch_size`` is the GLOBAL batch. Validation: pass an ordinary
+    (replicated) ``DeviceDataset`` as the val loader — val splits are small
+    and the whole-split eval is one dispatch either way.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, num_classes: int, *,
+                 batch_size: int, mesh, augment: Optional[Callable] = None,
+                 scale: Optional[float] = None):
+        from ..core.mesh import DATA_AXIS
+
+        x = np.asarray(x)
+        if len(x) != len(np.asarray(y)):
+            raise ValueError(
+                f"x/y length mismatch: {len(x)} vs {len(np.asarray(y))}")
+        d = mesh.shape[DATA_AXIS]
+        self.mesh = mesh
+        self.num_classes = int(num_classes)
+        self.batch_size = int(batch_size)
+        if self.batch_size % d != 0:
+            raise ValueError(f"global batch {batch_size} % data size {d} != 0")
+        self.augment = augment
+        self.scale = float(scale if scale is not None
+                           else (1.0 / 255.0 if x.dtype == np.uint8 else 1.0))
+        self.x, self.y = stage_sharded(x, y, mesh)
+        self.num_samples = int(self.x.shape[0])
+        self.local_samples = self.num_samples // d
+
+    @property
+    def steps_per_epoch(self) -> int:
+        from ..core.mesh import DATA_AXIS
+        return self.local_samples // (self.batch_size
+                                      // self.mesh.shape[DATA_AXIS])
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+
+@functools.lru_cache(maxsize=32)
+def _resident_epoch_dp_cached(model, loss_fn, optimizer, num_classes,
+                              batch_size, mesh, augment, scale,
+                              num_microbatches, _mode):
+    return make_resident_epoch_dp(model, loss_fn, optimizer,
+                                  num_classes=num_classes,
+                                  batch_size=batch_size, mesh=mesh,
+                                  augment=augment, scale=scale,
+                                  num_microbatches=num_microbatches)
+
+
+def resident_epoch_dp(model, loss_fn, optimizer, dataset: ShardedDeviceDataset,
+                      num_microbatches: int = 1):
+    """Memoized DP epoch fn (precision-keyed like :func:`resident_epoch`)."""
+    from ..core.precision import get_precision_mode
+    return _resident_epoch_dp_cached(model, loss_fn, optimizer,
+                                     dataset.num_classes, dataset.batch_size,
+                                     dataset.mesh, dataset.augment,
+                                     dataset.scale, num_microbatches,
+                                     get_precision_mode())
 
 
 def stage_sharded(x, y, mesh):

@@ -194,7 +194,13 @@ def _make_eval_step_cached(model: Sequential, loss_fn: Callable, _mode: str):
 
 def evaluate_classification(model, params, state, loss_fn, loader,
                             eval_step=None) -> Tuple[float, float]:
-    from ..data.device_dataset import DeviceDataset, resident_eval
+    from ..data.device_dataset import (
+        DeviceDataset, ShardedDeviceDataset, resident_eval)
+    if isinstance(loader, ShardedDeviceDataset):
+        raise TypeError(
+            "validation over a ShardedDeviceDataset is not supported — val "
+            "splits are small: stage them replicated with DeviceDataset "
+            "(whole-split eval is one dispatch either way)")
     if isinstance(loader, DeviceDataset):
         # HBM-resident split: one device dispatch for the whole validation
         # pass (full batches + exact remainder — see data/device_dataset.py)
@@ -250,7 +256,9 @@ class Trainer:
 
     def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
                     epoch: int = 0) -> Tuple[TrainState, float, float]:
-        from ..data.device_dataset import DeviceDataset
+        from ..data.device_dataset import DeviceDataset, ShardedDeviceDataset
+        if isinstance(loader, ShardedDeviceDataset):
+            return self._train_epoch_resident(ts, loader, rng, epoch, dp=True)
         if isinstance(loader, DeviceDataset):
             return self._train_epoch_resident(ts, loader, rng, epoch)
         if self.multi_step is not None:
@@ -279,14 +287,32 @@ class Trainer:
         return ts, (total_loss / max(total_n, 1)), (total_correct / max(total_n, 1))
 
     def _train_epoch_resident(self, ts: TrainState, ds, rng: jax.Array,
-                              epoch: int = 0) -> Tuple[TrainState, float, float]:
+                              epoch: int = 0, dp: bool = False,
+                              ) -> Tuple[TrainState, float, float]:
         """HBM-resident epoch: ONE device dispatch runs shuffle + gather +
         decode + augment + every train step (data/device_dataset.py). Zero
         steady-state H2D; train accuracy is not materialized (NaN — validation
         measures real accuracy), matching the chunked path's contract.
         Per-batch LR schedules ship as a [steps] vector; metric-driven
         schedulers see the previous epoch's mean train loss (per-epoch
-        granularity — mid-epoch losses never reach the host in this mode)."""
+        granularity — mid-epoch losses never reach the host in this mode).
+        ``dp=True`` (ShardedDeviceDataset): the data-parallel variant — the
+        dataset lives sharded over the mesh and every device runs the epoch
+        with grad pmean (data/device_dataset.py:make_resident_epoch_dp);
+        the scalar-lr path only (per-batch lr vectors not yet threaded)."""
+        if dp:
+            from ..data.device_dataset import resident_epoch_dp
+            epoch_fn = resident_epoch_dp(self.model, self.loss_fn,
+                                         self.optimizer, ds,
+                                         self.config.num_microbatches)
+            if (self.scheduler is not None
+                    and self.config.scheduler_step == "batch"):
+                raise NotImplementedError(
+                    "per-batch LR scheduling with ShardedDeviceDataset: the "
+                    "DP epoch takes a scalar lr; use scheduler_step='epoch'")
+            ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
+                                     jax.random.fold_in(rng, epoch), self.lr)
+            return ts, float(mean_loss), float("nan")
         from ..data.device_dataset import resident_epoch
         epoch_fn = resident_epoch(self.model, self.loss_fn, self.optimizer, ds,
                                   self.config.num_microbatches)
@@ -377,7 +403,9 @@ class Trainer:
                 # reference print cadence: print_profiling_summary per run,
                 # sequential.hpp:323-418).
                 self.profiler.maybe_clear_per_batch()
-                from ..data.device_dataset import DeviceDataset as _DD
+                from ..data.device_dataset import (
+                    DeviceDataset, ShardedDeviceDataset)
+                _DD = (DeviceDataset, ShardedDeviceDataset)
                 if isinstance(train_loader, _DD):
                     # resident mode: profile one decoded batch off the staged
                     # split (augmentation excluded — it's fused in-step there)
