@@ -36,9 +36,9 @@ Env knobs: BENCH_MODEL (resnet18 default | resnet50), BENCH_BATCH (default
 1024), BENCH_STEPS (default 20), BENCH_REPS (default 3), DCNN_PRECISION
 (default bf16 = mixed-precision activations; "fast" = bf16 MXU with fp32
 storage; "parity" for fp32), BENCH_CHUNK (train steps per device dispatch
-via the in-jit train loop train.make_multi_step; default 10 — measured
-21.2k vs 18.0k img/s at chunk=1 on the tunnelled v5e host, the in-jit loop
-amortizes per-dispatch launch latency), BENCH_FORMAT (NHWC default —
+via the in-jit train loop train.make_multi_step; default 20 — r3 sweep:
+10 -> 23.9k, 20 -> 26.9k, 50 -> 27.0k img/s on the tunnelled v5e host, the
+in-jit loop amortizes per-dispatch launch latency), BENCH_FORMAT (NHWC default —
 TPU-preferred tiling), BENCH_MATRIX=1 for the layout/dtype sweep,
 BENCH_RESIDENT_SAMPLES (resident-path dataset size, default 50 batches),
 BENCH_PROFILE=/path to dump a jax.profiler trace.
@@ -286,10 +286,11 @@ def main() -> None:
     reps = int(os.environ.get("BENCH_REPS", "3"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
     profile_dir = os.environ.get("BENCH_PROFILE")
-    # default 10 steps per dispatch: measured 21.2k vs 18.0k img/s at chunk=1
-    # on the tunnelled v5e host — per-dispatch launch latency rides the
-    # tunnel, and the in-jit multi-step loop amortizes it
-    chunk = int(os.environ.get("BENCH_CHUNK", "10"))
+    # default 20 steps per dispatch (r3 sweep on the tunnelled v5e host:
+    # chunk 10 -> 23.9k, 20 -> 26.9k, 50 -> 27.0k img/s; 20 is within noise
+    # of 50 at 2.5x less staged-batch memory) — per-dispatch launch latency
+    # rides the tunnel and the in-jit multi-step loop amortizes it
+    chunk = int(os.environ.get("BENCH_CHUNK", "20"))
 
     (img_per_sec, sec_per_step, tflops, pipeline_ips, h2d_gbps,
      resident_ips) = run_config(
