@@ -273,10 +273,16 @@ def make_zigzag_ring_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
     return precision_keyed_jit(f)
 
 
-def _ulysses_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+def _ulysses_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float,
+                   interpret=None):
     """Per-device body: all_to_all seq-shard → head-shard, full local
-    attention, all_to_all back. Local shapes in: (B, H, S/n, D)."""
-    from ..ops.attention import blockwise_attention
+    attention, all_to_all back. Local shapes in: (B, H, S/n, D).
+
+    The local attention is the Pallas flash kernel (fwd + dq/dk/dv backward
+    with causal tile skipping — the r3 kernels): on TPU this is the 3-6×
+    path; off-TPU it falls back to the numerically-identical blockwise scan,
+    so mesh tests stay exact."""
+    from ..ops.attention import flash_attention
 
     # (B, H, S/n, D) -> (B, H/n, S, D): split heads across devices, gather seq
     def swap_in(x):
@@ -288,15 +294,19 @@ def _ulysses_local(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
                                   tiled=True)
 
     qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)
-    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                          interpret=interpret)
     return swap_out(out)
 
 
 def make_ulysses_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
                            causal: bool = False,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           interpret=None):
     """Build Ulysses-style sequence-parallel attention over ``mesh[axis]``.
-    Requires H divisible by the axis size."""
+    Requires H divisible by the axis size. ``interpret`` forwards to
+    :func:`~dcnn_tpu.ops.attention.flash_attention` (tests force the Pallas
+    interpreter off-TPU to cover the kernel+all_to_all composition)."""
     n = mesh.shape[axis]
 
     def f(q, k, v):
@@ -306,7 +316,8 @@ def make_ulysses_attention(mesh: Mesh, *, axis: str = SEQ_AXIS,
                 f"{axis!r} size {n}")
         s = q.shape[-1] ** -0.5 if scale is None else scale
         local = functools.partial(_ulysses_local, axis=axis, n=n,
-                                  causal=causal, scale=s)
+                                  causal=causal, scale=s,
+                                  interpret=interpret)
         spec = P(None, None, axis, None)
         return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -317,6 +317,25 @@ def test_ulysses_matches_full(rng, seq_mesh, causal):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_grads_match_full(rng, seq_mesh):
+    """Gradients through Ulysses: custom-VJP flash kernels (forced Pallas
+    interpreter off-TPU) composed with all_to_all's transpose rule — the
+    exact composition TPU training runs (review r3 finding)."""
+    from dcnn_tpu.ops.attention import _HAVE_PALLAS
+    if not _HAVE_PALLAS and jax.default_backend() != "tpu":
+        pytest.skip("Pallas unavailable in this jax build")
+    q, k, v = _qkv(rng, b=1, h=8, s=32, d=8)
+    interp = jax.default_backend() != "tpu"
+    uly = make_ulysses_attention(seq_mesh, causal=True, interpret=interp)
+
+    g_ref = jax.grad(lambda *a: jnp.sum(attention(*a, causal=True) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.grad(lambda *a: jnp.sum(uly(*a) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_uly):
+        np.testing.assert_allclose(np.asarray(b), a, atol=1e-4, rtol=1e-4)
+
+
 def test_ulysses_rejects_indivisible_heads(rng, seq_mesh):
     q, k, v = _qkv(rng, h=3)
     with pytest.raises(ValueError, match="divisible"):
