@@ -125,6 +125,12 @@ class DistributedPipelineCoordinator:
             if c in ("FORWARD_RESULT", "BACKWARD_DONE", "ERROR_REPORT") and \
                     g is not None and g != self._gen:
                 continue  # straggler from a dead batch
+            if c == "HEALTH_ACK" and \
+                    meta.get("nonce") != getattr(self, "_health_nonce", None):
+                # straggler from a timed-out/previous health_check: outside a
+                # probe (_health_nonce None) or with a stale nonce, drop it —
+                # it must never poison a batch join or a retried probe
+                continue
             if c == "ERROR_REPORT":
                 self.abort()
                 raise PipelineWorkerError(meta.get("stage_id", -1),
@@ -280,6 +286,25 @@ class DistributedPipelineCoordinator:
                     acked += 1
         except TimeoutError:
             pass
+
+    def health_check(self) -> List[Dict[str, Any]]:
+        """Heartbeat every worker (the HEALTH_CHECK command the reference
+        reserves in its CommandType enum but never wires,
+        command_type.hpp:20-68): returns one vitals dict per stage
+        ({stage_id, configured, gen, rss_kb}), ordered by stage. Raises
+        ``TimeoutError`` (via the inbox timeout) if any worker is dead —
+        the failure-detection probe to run between batches."""
+        import os
+        nonce = int.from_bytes(os.urandom(4), "little")
+        self._health_nonce = nonce   # _recv drops acks with any other nonce
+        try:
+            for chan in self.chans:
+                chan.send("HEALTH_CHECK", {"nonce": nonce})
+            acks = self._join("HEALTH_ACK", len(self.chans))
+        finally:
+            self._health_nonce = None
+        vitals = [meta for meta, _ in acks]
+        return sorted(vitals, key=lambda v: v.get("stage_id", -1))
 
     def shutdown(self) -> None:
         for chan in self.chans:

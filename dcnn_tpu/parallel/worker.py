@@ -157,6 +157,20 @@ class StageWorker:
                                             "report": self.stage.load.report()})
             return
 
+        if cmd == "HEALTH_CHECK":
+            # liveness + basic vitals (the reference reserves HEALTH_CHECK in
+            # its CommandType enum, command_type.hpp:20-68, without wiring
+            # it; here it is a real coordinator-driven heartbeat)
+            from ..utils.hardware import get_memory_usage_kb
+            self.coord.send("HEALTH_ACK", {
+                "stage_id": self.stage_id,
+                "nonce": meta.get("nonce"),
+                "configured": self.stage is not None,
+                "gen": self.gen,
+                "rss_kb": get_memory_usage_kb(),
+            })
+            return
+
         if cmd == "ABORT":
             # clean abort: drop residuals + accumulated grads so the next
             # batch starts consistent (VERDICT r1 weak #5); the new

@@ -137,6 +137,19 @@ def test_forward_only_and_load_reports(coord, rng):
     assert all(r["forward_count"] > 0 for r in reports)
 
 
+def test_health_check_heartbeat(coord):
+    """HEALTH_CHECK round trip: every worker answers with vitals (the
+    command the reference reserves but never wires)."""
+    vitals = coord.health_check()
+    assert [v["stage_id"] for v in vitals] == [0, 1]
+    assert all(v["configured"] for v in vitals)
+    # rss_kb is 0 on platforms without /proc/self/status; the protocol field
+    # must exist either way
+    assert all(v["rss_kb"] >= 0 for v in vitals)
+    # repeatable (fresh nonce each time)
+    assert len(coord.health_check()) == 2
+
+
 def test_worker_error_reported_and_recoverable(coord):
     """A bad input shape must surface as PipelineWorkerError with the remote
     traceback, and the pipeline must keep working afterwards (abort clears
