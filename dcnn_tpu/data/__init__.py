@@ -5,6 +5,7 @@ from .loader import BaseDataLoader, ArrayDataLoader, one_hot
 from .mnist import MNISTDataLoader
 from .cifar import CIFAR10DataLoader, CIFAR100DataLoader
 from .tiny_imagenet import TinyImageNetDataLoader
+from .regression import RegressionDataLoader
 from .wifi import UJIWiFiDataLoader
 from .synthetic import SyntheticClassificationLoader
 from .prefetch import PrefetchLoader
@@ -23,7 +24,8 @@ from .device_dataset import (
 __all__ = [
     "BaseDataLoader", "ArrayDataLoader", "one_hot",
     "MNISTDataLoader", "CIFAR10DataLoader", "CIFAR100DataLoader",
-    "TinyImageNetDataLoader", "UJIWiFiDataLoader", "SyntheticClassificationLoader",
+    "TinyImageNetDataLoader", "RegressionDataLoader", "UJIWiFiDataLoader",
+    "SyntheticClassificationLoader",
     "PrefetchLoader",
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",

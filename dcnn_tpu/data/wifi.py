@@ -12,25 +12,22 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Optional, Tuple
-
 import numpy as np
 
-from .loader import BaseDataLoader
+from .regression import RegressionDataLoader
 
 NOT_DETECTED = -100.0
 
 
-class UJIWiFiDataLoader(BaseDataLoader):
+class UJIWiFiDataLoader(RegressionDataLoader):
+    """WiFi RSSI → position; extends the generic RegressionDataLoader the
+    same way the reference's WifiDataLoader extends RegressionDataLoader
+    (``regression_data_loader.hpp:14`` → ``wifi_data_loader.hpp:27``)."""
+
     def __init__(self, csv_path: str, num_targets: int = 2,
                  normalize_targets: bool = True, **kw):
-        kw.setdefault("drop_last", False)
-        super().__init__(**kw)
-        self.csv_path = csv_path
-        self.num_targets = int(num_targets)
-        self.normalize_targets = bool(normalize_targets)
-        self.target_means: Optional[np.ndarray] = None
-        self.target_stds: Optional[np.ndarray] = None
+        super().__init__(csv_path=csv_path, num_targets=num_targets,
+                         normalize_targets=normalize_targets, **kw)
 
     def load_data(self) -> None:
         if not os.path.isfile(self.csv_path):
@@ -67,14 +64,4 @@ class UJIWiFiDataLoader(BaseDataLoader):
 
         # scale RSSI into [0,1]-ish range: (-100..0 dBm) → (0..1)
         feats = (feats - NOT_DETECTED) / (-NOT_DETECTED)
-        if self.normalize_targets:
-            self.target_means = targets.mean(axis=0)
-            self.target_stds = targets.std(axis=0) + 1e-8
-            targets = (targets - self.target_means) / self.target_stds
-        self._x = feats
-        self._y = targets
-
-    def denormalize_targets(self, y: np.ndarray) -> np.ndarray:
-        if self.target_means is None:
-            return y
-        return y * self.target_stds + self.target_means
+        self._finalize(feats, targets)

@@ -21,8 +21,17 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "src", "dataio.cpp")
+_SRC_DIR = os.path.join(_DIR, "src")
 _SO = os.path.join(_DIR, "libdcnn_native.so")
+
+
+def _sources() -> list:
+    try:
+        return sorted(
+            os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+            if f.endswith(".cpp"))
+    except OSError:
+        return []
 
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
@@ -34,7 +43,7 @@ def _build() -> bool:
     # processes) can never CDLL a partially written .so.
     tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-pthread", _SRC, "-o", tmp]
+           "-pthread", *_sources(), "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
@@ -53,9 +62,10 @@ def lib() -> Optional[ctypes.CDLL]:
         return _lib
     if _build_failed:
         return None
-    have_src = os.path.isfile(_SRC)
+    srcs = _sources()
+    have_src = bool(srcs)
     stale = (have_src and os.path.isfile(_SO)
-             and os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+             and os.path.getmtime(_SO) < max(os.path.getmtime(s) for s in srcs))
     if not os.path.isfile(_SO) or stale:
         if not have_src or not _build():
             _build_failed = True
@@ -79,8 +89,55 @@ def lib() -> Optional[ctypes.CDLL]:
         ctypes.c_float, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
     l.dcnn_parse_label_csv.restype = ctypes.c_int64
+    # A prebuilt .so from before lz4codec.cpp existed may lack these symbols
+    # (e.g. deployed without src/, defeating the mtime staleness check) —
+    # degrade to "lz4 unavailable" rather than failing lib() entirely.
+    if hasattr(l, "dcnn_lz4_compress"):
+        for fn in ("dcnn_lz4_compress", "dcnn_lz4_decompress"):
+            getattr(l, fn).argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+            getattr(l, fn).restype = ctypes.c_int64
+        l.dcnn_lz4_compress_bound.argtypes = [ctypes.c_int64]
+        l.dcnn_lz4_compress_bound.restype = ctypes.c_int64
     _lib = l
     return _lib
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def lz4_available() -> bool:
+    l = lib()
+    return l is not None and hasattr(l, "dcnn_lz4_compress")
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    """LZ4 block-format compress (native). None if the lib is unavailable."""
+    l = lib()
+    if l is None or not hasattr(l, "dcnn_lz4_compress"):
+        return None
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(int(l.dcnn_lz4_compress_bound(len(data))), np.uint8)
+    n = l.dcnn_lz4_compress(_u8ptr(src), src.size, _u8ptr(dst), dst.size)
+    if n < 0:
+        raise ValueError("lz4 compress: destination bound overflow")
+    return dst[:n].tobytes()
+
+
+def lz4_decompress(data: bytes, raw_size: int) -> Optional[bytes]:
+    """LZ4 block-format decompress into exactly raw_size bytes (native).
+    None if the lib is unavailable; raises on malformed input."""
+    l = lib()
+    if l is None or not hasattr(l, "dcnn_lz4_decompress"):
+        return None
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(raw_size, np.uint8)
+    n = l.dcnn_lz4_decompress(_u8ptr(src), src.size, _u8ptr(dst), raw_size)
+    if n != raw_size:
+        raise ValueError(f"lz4 decompress: malformed stream (rc={n})")
+    return dst.tobytes()
 
 
 def available() -> bool:

@@ -9,8 +9,10 @@ messages (``docs/pipeline_architecture.md:8``).
 On TPU intra-slice transfers ride ICI and are never compressed; compression
 matters only for host-path/DCN transfers (checkpoint shipping, cross-site
 coordination). Available codecs here: zstd (preferred; same default codec as
-the reference) and zlib (always present). A ``MetaCompressor`` dispatches by
-codec id, wire-compatible layout: ``[1-byte codec id][u64 raw size][payload]``.
+the reference), zlib (always present), and LZ4 block format via the native
+C++ library (``native/src/lz4codec.cpp`` — the reference's Lz4hcCompressor
+slot). A ``MetaCompressor`` dispatches by codec id, wire-compatible layout:
+``[1-byte codec id][u64 raw size][payload]``.
 """
 
 from __future__ import annotations
@@ -80,6 +82,28 @@ class ZstdCompressor:
         return self._d.decompress(data, max_output_size=raw_size or 2**31)
 
 
+class Lz4Compressor:
+    """LZ4 block format through the native C++ codec
+    (reference ``internal_compressor.hpp:5-15`` Lz4hcCompressor). Fastest
+    codec here on host CPU; worse ratio than zstd — the right pick when the
+    link is fast relative to the host (the reference defaults pipeline
+    activations to lz4hc for the same reason)."""
+
+    codec_id = 3
+
+    def __init__(self):
+        from .. import native as _native
+        if not _native.lz4_available():
+            raise RuntimeError("native lz4 codec unavailable (no toolchain)")
+        self._n = _native
+
+    def compress(self, data: bytes) -> bytes:
+        return self._n.lz4_compress(data)
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        return self._n.lz4_decompress(data, raw_size)
+
+
 class MetaCompressor:
     """Codec-id-framed dispatch (reference ``meta_compressor.hpp:10-35``)."""
 
@@ -90,6 +114,10 @@ class MetaCompressor:
         self.register(RawCompressor())
         zl = ZlibCompressor()
         self.register(zl)
+        # lz4 is NOT registered eagerly: constructing it may trigger the
+        # native g++ build, and MetaCompressor() runs at import time in the
+        # comm stack. decompress() registers it lazily on first codec-id-3
+        # frame; compress-side callers pass Lz4Compressor() explicitly.
         if _zstd is not None:
             zs = ZstdCompressor()
             self.register(zs)
@@ -106,6 +134,11 @@ class MetaCompressor:
 
     def decompress(self, blob: bytes) -> bytes:
         codec_id, raw_size = self._HEADER.unpack_from(blob)
+        if codec_id == Lz4Compressor.codec_id and codec_id not in self.codecs:
+            try:
+                self.register(Lz4Compressor())
+            except RuntimeError:
+                pass
         if codec_id not in self.codecs:
             raise ValueError(f"unknown codec id {codec_id}")
         return self.codecs[codec_id].decompress(blob[self._HEADER.size:], raw_size)

@@ -1,0 +1,55 @@
+"""CIFAR-10 VGG-style CNN trainer (reference
+``examples/cifar10_cnn_trainer.cpp``): the ``cifar10_cnn_classifier_v2``
+model with the reference's augmentation recipe — rotation, brightness,
+contrast, gaussian noise, random crop (:38-45) — Adam + softmax
+cross-entropy (:95-99)."""
+
+from common import loader_or_synthetic, prepare_input, setup
+
+from dcnn_tpu.data import (AugmentationBuilder, CIFAR10DataLoader,
+                           DeviceAugmentBuilder)
+from dcnn_tpu.models import create_cifar10_trainer_v2
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.train import train_classification_model
+from dcnn_tpu.utils.env import get_env
+
+
+def main():
+    cfg = setup("cifar10_cnn")
+    # reference aug_strategy (cifar10_cnn_trainer.cpp:38-45)
+    aug = (AugmentationBuilder()
+           .rotation(10.0, 0.3)
+           .brightness(0.15, 0.3)
+           .contrast(0.85, 1.15, 0.3)
+           .gaussian_noise(0.05, 0.3)
+           .random_crop(4, 0.4)
+           .build())
+
+    def real():
+        root = get_env("CIFAR10_DIR", "data/cifar-10-batches-bin")
+        train = CIFAR10DataLoader(
+            [f"{root}/data_batch_{i}.bin" for i in range(1, 6)],
+            batch_size=cfg.batch_size, seed=cfg.seed, augmentation=aug)
+        val = CIFAR10DataLoader(f"{root}/test_batch.bin",
+                                batch_size=cfg.batch_size, shuffle=False)
+        train.load_data()
+        val.load_data()
+        return train, val
+
+    train_loader, val_loader = loader_or_synthetic(real, (3, 32, 32), 10, cfg)
+    # RESIDENT=1: the same recipe as on-device ops (rotation has no device
+    # analog; the crop/photometric ops carry the regularization weight)
+    dev_aug = (DeviceAugmentBuilder("NCHW")
+               .brightness(0.15, 0.3).contrast(0.85, 1.15, 0.3)
+               .gaussian_noise(0.05, 0.3).random_crop(4, 0.4).build())
+    train_loader, val_loader = prepare_input(
+        train_loader, val_loader, 10, cfg, device_augment=dev_aug)
+    model = create_cifar10_trainer_v2()
+    print(model.summary())
+    train_classification_model(model, Adam(cfg.learning_rate),
+                               "softmax_crossentropy", train_loader, val_loader,
+                               config=cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,53 @@ def test_loaders_use_native_and_match_fallback(tmp_path, rng, monkeypatch):
     l2.load_data()
     np.testing.assert_allclose(l1._x, l2._x, rtol=1e-7)
     np.testing.assert_array_equal(l1._y, l2._y)
+
+
+# -- LZ4 block codec (lz4codec.cpp; reference internal_compressor.hpp:5-15) --
+
+@requires_native
+def test_lz4_roundtrip_payload_classes(rng):
+    payloads = [
+        b"",
+        b"x",
+        b"abc",                                   # below min-match, all literal
+        b"a" * 100_000,                           # max-compressible RLE
+        bytes(rng.integers(0, 256, 70_000, dtype=np.uint8)),  # incompressible
+        np.arange(4096, dtype=np.float32).tobytes(),          # structured
+        (b"the quick brown fox " * 5000),         # long-range repeats > 64k window
+    ]
+    for p in payloads:
+        c = native.lz4_compress(p)
+        assert native.lz4_decompress(c, len(p)) == p
+    # repetitive data must actually compress
+    assert len(native.lz4_compress(b"a" * 100_000)) < 1000
+
+
+@requires_native
+def test_lz4_decompress_spec_vector():
+    """Hand-encoded stream per the public LZ4 block spec: token 0x17 =
+    1 literal + (7+4)-byte match at offset 1 → 12 × 'a'."""
+    stream = bytes([0x17]) + b"a" + bytes([0x01, 0x00])
+    assert native.lz4_decompress(stream, 12) == b"a" * 12
+
+
+@requires_native
+def test_lz4_malformed_stream_raises():
+    # offset 2 with only 1 byte of history → must be rejected, not OOB-read
+    bad = bytes([0x17]) + b"a" + bytes([0x02, 0x00])
+    with pytest.raises(ValueError):
+        native.lz4_decompress(bad, 12)
+    with pytest.raises(ValueError):  # truncated literals
+        native.lz4_decompress(bytes([0xF0, 0xFF]), 300)
+
+
+@requires_native
+def test_lz4_via_meta_compressor():
+    from dcnn_tpu.utils.compression import Lz4Compressor, MetaCompressor
+    mc = MetaCompressor()
+    assert 3 not in mc.codecs  # not eager: construction must stay import-cheap
+    payload = np.arange(10_000, dtype=np.int32).tobytes()
+    blob = mc.compress(payload, Lz4Compressor())
+    assert blob[0] == 3
+    assert mc.decompress(blob) == payload  # lazily registered on first id-3
+    assert 3 in mc.codecs
