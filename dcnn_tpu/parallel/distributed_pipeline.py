@@ -22,6 +22,7 @@ cache/grad reset so the next batch starts from a consistent state.
 
 from __future__ import annotations
 
+import collections
 import io
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -78,6 +79,9 @@ class DistributedPipelineCoordinator:
         # batch generation: bumped on abort; both ends drop messages from a
         # dead generation so in-flight stragglers can't poison the next batch
         self._gen = 0
+        # messages deferred by a buffering join (health_check): consumed by
+        # _recv before the socket inbox so they are never lost
+        self._deferred = collections.deque()
 
         def _lg(pred, tgt):
             return jax.value_and_grad(self.loss_fn)(pred, tgt)
@@ -117,7 +121,10 @@ class DistributedPipelineCoordinator:
     # -- fenced receive: drops messages from aborted generations --
     def _recv(self) -> Tuple[str, Dict, Any]:
         while True:
-            c, meta, payload, _ = self.inbox.get(timeout=self.timeout)
+            if self._deferred:
+                c, meta, payload = self._deferred.popleft()
+            else:
+                c, meta, payload, _ = self.inbox.get(timeout=self.timeout)
             # fence only messages that actually carry a generation: an
             # ERROR_REPORT from a gen-less command (CONFIG_TRANSFER,
             # UPDATE_PARAMETERS) has gen=None and must never be dropped
@@ -131,6 +138,9 @@ class DistributedPipelineCoordinator:
                 # probe (_health_nonce None) or with a stale nonce, drop it —
                 # it must never poison a batch join or a retried probe
                 continue
+            if c in ("PROFILING_REPORT", "PROFILING_CLEARED") and \
+                    meta.get("nonce") != getattr(self, "_profiling_nonce", None):
+                continue  # same staleness fence for profiling replies
             if c == "ERROR_REPORT":
                 self.abort()
                 raise PipelineWorkerError(meta.get("stage_id", -1),
@@ -138,13 +148,27 @@ class DistributedPipelineCoordinator:
             return c, meta, payload
 
     # -- cv-join analog (coordinator.hpp:253-265) --
-    def _join(self, cmd: str, count: int) -> List[Tuple[Dict, Any]]:
+    def _join(self, cmd: str, count: int,
+              buffer_others: bool = False) -> List[Tuple[Dict, Any]]:
+        """Collect ``count`` messages of kind ``cmd``. With
+        ``buffer_others`` (the out-of-band joins: health probes), messages of
+        any other kind are deferred for the next join instead of treated as
+        protocol errors — a probe racing an in-flight batch message must not
+        drop it (ADVICE r3 #3). Deferred messages re-enter through _recv, so
+        generation fencing still applies when they are finally consumed."""
         got: List[Tuple[Dict, Any]] = []
-        while len(got) < count:
-            c, meta, payload = self._recv()
-            if c != cmd:
-                raise RuntimeError(f"expected {cmd}, got {c}")
-            got.append((meta, payload))
+        deferred: List[Tuple[str, Dict, Any]] = []
+        try:
+            while len(got) < count:
+                c, meta, payload = self._recv()
+                if c == cmd:
+                    got.append((meta, payload))
+                elif buffer_others:
+                    deferred.append((c, meta, payload))
+                else:
+                    raise RuntimeError(f"expected {cmd}, got {c}")
+        finally:
+            self._deferred.extend(deferred)
         return got
 
     def _first(self) -> Channel:
@@ -267,6 +291,34 @@ class DistributedPipelineCoordinator:
         by_stage = {m["stage_id"]: m["report"] for m, _ in got}
         return [by_stage[i] for i in range(self.num_stages)]
 
+    # -- per-layer profiling broadcast (coordinator.hpp:384-403) --
+    def _profiling_round(self, request: str, reply: str) -> List[Tuple[Dict, Any]]:
+        """One nonce-fenced broadcast/join: like HEALTH_CHECK, a reply from a
+        timed-out earlier round must never satisfy a later join or leak into
+        a batch join — ``_recv`` drops ``reply`` messages whose nonce is not
+        the currently-armed one (review r4 finding)."""
+        import os as _os
+        nonce = int.from_bytes(_os.urandom(4), "little")
+        self._profiling_nonce = nonce
+        try:
+            for chan in self.chans:
+                chan.send(request, {"nonce": nonce})
+            return self._join(reply, self.num_stages, buffer_others=True)
+        finally:
+            self._profiling_nonce = None
+
+    def collect_profiling(self) -> List[Dict[str, Any]]:
+        """Broadcast PRINT_PROFILING; every worker replays its latest
+        microbatch through the fenced per-layer profiler and returns its
+        table. Ordered by stage. Run between batches (uses a buffering join,
+        so a straggling batch message is deferred, not dropped)."""
+        got = self._profiling_round("PRINT_PROFILING", "PROFILING_REPORT")
+        by_stage = {m["stage_id"]: m["profile"] for m, _ in got}
+        return [by_stage[i] for i in range(self.num_stages)]
+
+    def clear_profiling(self) -> None:
+        self._profiling_round("CLEAR_PROFILING", "PROFILING_CLEARED")
+
     # -- failure handling --
     def abort(self) -> None:
         """Bump the batch generation (fencing out every in-flight message of
@@ -293,14 +345,17 @@ class DistributedPipelineCoordinator:
         command_type.hpp:20-68): returns one vitals dict per stage
         ({stage_id, configured, gen, rss_kb}), ordered by stage. Raises
         ``TimeoutError`` (via the inbox timeout) if any worker is dead —
-        the failure-detection probe to run between batches."""
+        the failure-detection probe to run between batches. Safe against a
+        mistimed probe: batch messages arriving during the join are deferred,
+        not dropped."""
         import os
         nonce = int.from_bytes(os.urandom(4), "little")
         self._health_nonce = nonce   # _recv drops acks with any other nonce
         try:
             for chan in self.chans:
                 chan.send("HEALTH_CHECK", {"nonce": nonce})
-            acks = self._join("HEALTH_ACK", len(self.chans))
+            acks = self._join("HEALTH_ACK", len(self.chans),
+                              buffer_others=True)
         finally:
             self._health_nonce = None
         vitals = [meta for meta, _ in acks]

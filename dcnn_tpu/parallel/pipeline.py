@@ -133,6 +133,12 @@ class PipelineStage:
         self._grad_acc: Any = None
         self._grad_count = 0
         self.load = StageLoadTracker()
+        # most recent microbatch (x, rng, training) — the replay probe for
+        # on-demand per-layer profiling (holds ONE extra activation alive;
+        # the reference's stages likewise keep per-layer timing state,
+        # pipeline_stage.hpp:138-159)
+        self._probe: Optional[Tuple[Any, Any, bool]] = None
+        self._profiler = None
         self._build_steps()
 
     # -- deployment --
@@ -205,6 +211,7 @@ class PipelineStage:
                 hard_fence((self._last_out, x))
             t0 = time.perf_counter()
             y, new_state = self._fwd(self.params, self.state, x, rng, training)
+            self._probe = (x, rng, training)
             if training:
                 # residuals for backward; BN etc. must see the pre-update state
                 self._cache[mb_id] = (x, self.state, rng)
@@ -275,6 +282,42 @@ class PipelineStage:
             self.params, self.opt_state, self._grad_acc,
             jnp.asarray(lr, jnp.float32), scale)
         self._grad_count = 0
+
+    # -- per-layer profiling (reference PRINT_PROFILING/CLEAR_PROFILING,
+    #    coordinator.hpp:384-403, pipeline_stage.hpp:138-159) --
+    def collect_profile(self) -> Dict[str, Any]:
+        """Per-layer fwd/bwd µs table for this stage's partition.
+
+        The training fast path is a fused jit (per-layer timers inside it
+        would be meaningless — XLA fuses across layers), so this replays the
+        most recent microbatch through the eager fenced
+        :class:`~dcnn_tpu.train.profiling.LayerProfiler` — a profiling run
+        at the reference's cost model (its stages time layer-by-layer with
+        device syncs too). Repeated calls accumulate (CUMULATIVE mode);
+        :meth:`clear_profile` resets. Returns a JSON-serializable dict:
+        ``{"stage_id", "layers": [{"name","fwd_us","bwd_us","calls"}, ...]}``
+        with empty layers if no microbatch has been processed yet."""
+        if self._probe is None or self.params is None:
+            return {"stage_id": self.stage_id, "layers": []}
+        from ..train.profiling import LayerProfiler
+        if self._profiler is None:
+            self._profiler = LayerProfiler()
+        x, rng, training = self._probe
+        prof = self._profiler
+        out, _ = prof.profile_forward(self.model, self.params, self.state, x,
+                                      training=training, rng=rng)
+        prof.profile_backward(self.model, self.params, self.state, x,
+                              jnp.ones_like(out), training=training, rng=rng)
+        layers = [{"name": l.name,
+                   "fwd_us": round(prof.forward_us.get(l.name, 0.0), 1),
+                   "bwd_us": round(prof.backward_us.get(l.name, 0.0), 1),
+                   "calls": prof.counts.get(l.name, 0)}
+                  for l in self.model.layers]
+        return {"stage_id": self.stage_id, "layers": layers}
+
+    def clear_profile(self) -> None:
+        if self._profiler is not None:
+            self._profiler.clear()
 
     def clear_cache(self) -> None:
         self._cache.clear()
@@ -503,6 +546,15 @@ class InProcessPipelineCoordinator:
     def collect_load_reports(self) -> List[Dict[str, float]]:
         return [s.load.report() for s in self.stages]
 
+    # -- per-layer profiling (coordinator.hpp:384-403 broadcasts
+    #    PRINT_PROFILING/CLEAR_PROFILING to every stage) --
+    def collect_profiling(self) -> List[Dict[str, Any]]:
+        return [s.collect_profile() for s in self.stages]
+
+    def clear_profiling(self) -> None:
+        for s in self.stages:
+            s.clear_profile()
+
     # -- gather weights back (for checkpoint/eval on one device) --
     def gathered_params(self) -> Tuple[Any, Any]:
         params: List[Any] = []
@@ -511,6 +563,23 @@ class InProcessPipelineCoordinator:
             params.extend(jax.device_get(stage.params))
             state.extend(jax.device_get(stage.state))
         return tuple(params), tuple(state)
+
+
+def format_profiling(tables: List[Dict[str, Any]]) -> str:
+    """Render per-stage per-layer profile tables (the reference's
+    ``print_profiling_summary`` over all stages, coordinator.hpp:384-403).
+    Accepts the output of either coordinator's ``collect_profiling()``."""
+    lines = [f"{'stage':>5} {'layer':<28} {'fwd µs':>12} {'bwd µs':>12} {'calls':>7}"]
+    for t in tables:
+        sid = t.get("stage_id", -1)
+        rows = t.get("layers", [])
+        if not rows:
+            lines.append(f"{sid:>5} (no microbatch processed yet)")
+            continue
+        for r in rows:
+            lines.append(f"{sid:>5} {r['name']:<28} {r['fwd_us']:>12.1f} "
+                         f"{r['bwd_us']:>12.1f} {r['calls']:>7}")
+    return "\n".join(lines)
 
 
 def train_pipeline_batch_sync(coord: InProcessPipelineCoordinator, x, y, lr,

@@ -157,6 +157,22 @@ class StageWorker:
                                             "report": self.stage.load.report()})
             return
 
+        if cmd == "PRINT_PROFILING":
+            # per-layer fwd/bwd µs table (reference PRINT_PROFILING
+            # broadcast, coordinator.hpp:384-403 / pipeline_stage.hpp:138-159);
+            # the echoed nonce lets the coordinator fence stale replies
+            self.coord.send("PROFILING_REPORT",
+                            {"stage_id": self.stage_id,
+                             "nonce": meta.get("nonce"),
+                             "profile": self.stage.collect_profile()})
+            return
+
+        if cmd == "CLEAR_PROFILING":
+            self.stage.clear_profile()
+            self.coord.send("PROFILING_CLEARED", {"stage_id": self.stage_id,
+                                                  "nonce": meta.get("nonce")})
+            return
+
         if cmd == "HEALTH_CHECK":
             # liveness + basic vitals (the reference reserves HEALTH_CHECK in
             # its CommandType enum, command_type.hpp:20-68, without wiring

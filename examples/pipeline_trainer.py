@@ -50,6 +50,11 @@ def main():
         for sid, rep in enumerate(coord.collect_load_reports()):
             print(f"  stage {sid}: fwd {rep['avg_forward_ms']:.2f}ms "
                   f"bwd {rep['avg_backward_ms']:.2f}ms")
+        if get_env("PIPELINE_PROFILE", 0):
+            # per-layer table from every stage (reference PRINT_PROFILING)
+            from dcnn_tpu.parallel.pipeline import format_profiling
+            print(format_profiling(coord.collect_profiling()))
+            coord.clear_profiling()
 
 
 if __name__ == "__main__":

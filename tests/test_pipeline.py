@@ -164,3 +164,28 @@ def test_microbatch_cache_isolation():
     from dcnn_tpu.parallel import PipelineError
     with pytest.raises(PipelineError):
         stage.backward(2, g)
+
+
+def test_in_process_profiling_collection():
+    """In-process collect_profiling mirrors the distributed PRINT_PROFILING
+    broadcast: per-layer tables per stage, empty before any batch."""
+    model = _model()
+    coord = InProcessPipelineCoordinator(model, SGD(0.01), "softmax_crossentropy",
+                                         num_stages=2, num_microbatches=2)
+    coord.deploy_stages(KEY)
+    # before any microbatch: empty tables, formatter copes
+    from dcnn_tpu.parallel.pipeline import format_profiling
+    empty = coord.collect_profiling()
+    assert all(t["layers"] == [] for t in empty)
+    assert "no microbatch" in format_profiling(empty)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 1, 8, 8))
+    y = np.eye(10, dtype=np.float32)[np.random.default_rng(0).integers(0, 10, 4)]
+    coord.train_batch_sync(x, y, 0.01, jax.random.PRNGKey(2))
+    tables = coord.collect_profiling()
+    names = [r["name"] for t in tables for r in t["layers"]]
+    assert names == [l.name for l in model.layers]
+    assert all(r["fwd_us"] > 0 and r["bwd_us"] > 0
+               for t in tables for r in t["layers"])
+    coord.clear_profiling()
+    assert coord.collect_profiling()[0]["layers"][0]["calls"] == 1

@@ -144,3 +144,76 @@ def test_sampled_load_tracking():
 def test_track_load_validation():
     with pytest.raises(ValueError):
         _coord(track_load="always")
+
+
+def test_health_join_defers_batch_messages():
+    """A health probe racing an in-flight batch message must defer it for
+    the next join, not raise and drop it (ADVICE r3 #3)."""
+    from dcnn_tpu.parallel.comm import Inbox
+    from dcnn_tpu.parallel.distributed_pipeline import (
+        DistributedPipelineCoordinator)
+
+    co = DistributedPipelineCoordinator.__new__(DistributedPipelineCoordinator)
+    co.inbox = Inbox()
+    co.timeout = 1.0
+    co._gen = 0
+    import collections
+    co._deferred = collections.deque()
+    co._health_nonce = 42
+    # arrival order: a straggling batch result lands before the health acks
+    co.inbox._q.put(("FORWARD_RESULT", {"mb_id": 0, "gen": 0}, "act", None))
+    co.inbox._q.put(("HEALTH_ACK", {"stage_id": 0, "nonce": 42}, None, None))
+    co.inbox._q.put(("HEALTH_ACK", {"stage_id": 1, "nonce": 42}, None, None))
+    acks = co._join("HEALTH_ACK", 2, buffer_others=True)
+    assert [m["stage_id"] for m, _ in acks] == [0, 1]
+    # the batch message was deferred, not lost: the next join consumes it
+    co._health_nonce = None
+    got = co._join("FORWARD_RESULT", 1)
+    assert got[0][1] == "act"
+
+
+def test_strict_join_still_rejects_protocol_errors():
+    from dcnn_tpu.parallel.comm import Inbox
+    from dcnn_tpu.parallel.distributed_pipeline import (
+        DistributedPipelineCoordinator)
+    import collections
+
+    co = DistributedPipelineCoordinator.__new__(DistributedPipelineCoordinator)
+    co.inbox = Inbox()
+    co.timeout = 1.0
+    co._gen = 0
+    co._deferred = collections.deque()
+    co.inbox._q.put(("LOAD_REPORT", {"stage_id": 0}, None, None))
+    with pytest.raises(RuntimeError, match="expected PARAMETERS_UPDATED"):
+        co._join("PARAMETERS_UPDATED", 1)
+
+
+def test_stale_profiling_reply_is_dropped():
+    """A PROFILING_REPORT from a timed-out earlier round (wrong/absent nonce)
+    must be dropped at consumption, never satisfying a later join or leaking
+    into a batch join (review r4)."""
+    from dcnn_tpu.parallel.comm import Inbox
+    from dcnn_tpu.parallel.distributed_pipeline import (
+        DistributedPipelineCoordinator)
+    import collections
+
+    co = DistributedPipelineCoordinator.__new__(DistributedPipelineCoordinator)
+    co.inbox = Inbox()
+    co.timeout = 0.2
+    co._gen = 0
+    co._deferred = collections.deque()
+    co._profiling_nonce = 7
+
+    # straggler from a previous round (nonce 3) then the real reply (nonce 7)
+    co.inbox._q.put(("PROFILING_REPORT", {"stage_id": 0, "nonce": 3,
+                                          "profile": {"stale": True}}, None, None))
+    co.inbox._q.put(("PROFILING_REPORT", {"stage_id": 0, "nonce": 7,
+                                          "profile": {"stale": False}}, None, None))
+    got = co._join("PROFILING_REPORT", 1, buffer_others=True)
+    assert got[0][0]["profile"] == {"stale": False}
+
+    # outside any round (_profiling_nonce None) stragglers are dropped too
+    co._profiling_nonce = None
+    co.inbox._q.put(("PROFILING_CLEARED", {"stage_id": 0, "nonce": 3}, None, None))
+    with pytest.raises(TimeoutError):
+        co._join("ANYTHING", 1)
