@@ -193,10 +193,17 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
         ts3 = create_train_state(model, opt, key)
         ts3, l = epoch_fn(ts3, x_res, y_res, jax.random.fold_in(key, 7000), 1e-3)
         _hf(l)  # warmup: compile + first epoch
-        t0 = time.perf_counter()
-        ts3, l = epoch_fn(ts3, x_res, y_res, jax.random.fold_in(key, 7001), 1e-3)
-        _hf(l)
-        resident_img_per_sec = n_res / (time.perf_counter() - t0)
+        # best-of-reps, same discipline as _measure: a single epoch timing
+        # is exposed to one dispatch-jitter spike on the tunnelled host and
+        # skews feed_efficiency (ADVICE r3 #2)
+        best = float("inf")
+        for r in range(reps):
+            t0 = time.perf_counter()
+            ts3, l = epoch_fn(ts3, x_res, y_res,
+                              jax.random.fold_in(key, 7001 + r), 1e-3)
+            _hf(l)
+            best = min(best, time.perf_counter() - t0)
+        resident_img_per_sec = n_res / best
 
     pipeline_img_per_sec = h2d_gbps = None
     if pipeline and os.environ.get("BENCH_PIPELINE", "1") != "0":

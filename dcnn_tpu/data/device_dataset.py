@@ -381,16 +381,27 @@ def resident_epoch_dp(model, loss_fn, optimizer, dataset: ShardedDeviceDataset,
                                      get_precision_mode())
 
 
-def stage_sharded(x, y, mesh):
+def stage_sharded(x, y, mesh, *, global_shuffle_seed: Optional[int] = 0):
     """Stage a split sharded over the mesh's data axis (sample dim): each
-    device holds N/D contiguous samples in its own HBM. Trims the remainder
-    so shards are equal."""
+    device holds N/D samples in its own HBM. Trims the remainder so shards
+    are equal.
+
+    A seeded GLOBAL host-side permutation is applied before sharding
+    (``global_shuffle_seed=None`` disables it): the resident DP epoch only
+    reshuffles *within* each shard, so without this a class-sorted split
+    (e.g. Tiny-ImageNet directory order) would pin each device to a
+    class-biased shard forever — and BN would normalize every local batch
+    with class-conditional statistics (ADVICE r3 #1)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..core.mesh import DATA_AXIS
 
     d = mesh.shape[DATA_AXIS]
     n = (len(x) // d) * d
-    x, y = np.asarray(x)[:n], np.asarray(y)[:n]
+    x, y = np.asarray(x), np.asarray(y)
+    if global_shuffle_seed is not None:
+        perm = np.random.default_rng(global_shuffle_seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+    x, y = x[:n], y[:n]
     if y.ndim == 2:
         y = y.argmax(axis=-1)
     xs = jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS)))
@@ -417,7 +428,12 @@ def resident_epoch(model, loss_fn, optimizer, dataset: DeviceDataset,
                    num_microbatches: int = 1):
     """Memoized epoch fn for a (model, loss, optimizer, dataset geometry,
     precision-mode) combination — repeated ``fit`` calls reuse one compiled
-    executable per shape (precision-keyed per ADVICE r2 #4)."""
+    executable per shape (precision-keyed per ADVICE r2 #4).
+
+    Cache hits require the SAME model/optimizer/augment *objects* (the
+    lru_cache keys on identity — per-call reconstruction compiles a fresh
+    executable each time and ages live entries out of the 32-slot cache,
+    ADVICE r3 #4); the Trainer holds one of each for exactly this reason."""
     from ..core.precision import get_precision_mode
     return _resident_epoch_cached(model, loss_fn, optimizer,
                                   dataset.num_classes, dataset.batch_size,
