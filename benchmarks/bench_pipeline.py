@@ -250,15 +250,32 @@ def _hetero_padding_rows():
                                            key, jnp.float32(1e-2))
         losses[wire_name] = float(loss0)
 
-        # wire-traffic accounting: every tick ships the widest activation
-        # (padded); useful bytes are this stage's real output
-        from dcnn_tpu.parallel.compiled_pipeline import _prod
-        max_elems = max([_prod(pipe.in_shapes[0])]
-                        + [_prod(s) for s in pipe.out_shapes])
+        # wire-traffic accounting, MEASURED from the lowered program: the
+        # collective-permute operand widths are what actually crosses the
+        # wire. overhead = shipped / useful, where useful is each boundary
+        # activation's exact bytes (pipe.boundary_elems — shared with the
+        # engine). A regression back to padded-width shipping shows up as
+        # overhead > 1 AND flips this row's correctness gate.
+        import re
         bpe = jnp.dtype(wire).itemsize
-        shipped = mb * max_elems * bpe          # per hop
-        useful = [mb * _prod(s) * bpe for s in pipe.out_shapes]
-        overhead = shipped * len(useful) / max(sum(useful), 1)
+        bw = pipe.boundary_elems(mb)
+        lowered = step.lower(fp, opt_state, fs, mb_x, mb_y, key,
+                             jnp.float32(1e-2)).as_text()
+        hlo_widths = set()
+        for ln in lowered.splitlines():
+            if "collective_permute" in ln:
+                m = re.search(r"\(tensor<(\d+)x(?:f32|bf16|f16)>\)", ln)
+                if m:
+                    hlo_widths.add(int(m.group(1)))
+        wire_exact = hlo_widths == set(bw)
+        # each boundary ships at the smallest compiled width >= its own
+        # (exact-match bucketing ⇒ identity when wire_exact holds)
+        shipped_per_tick = sum(
+            min((h for h in hlo_widths if h >= w), default=max(hlo_widths or [0]))
+            for w in bw) * bpe
+        useful = [w * bpe for w in bw]
+        shipped = shipped_per_tick // max(len(bw), 1)   # avg per hop
+        overhead = shipped_per_tick / max(sum(useful), 1)
 
         def run(step=step):
             nonlocal fp, opt_state, fs
@@ -269,9 +286,10 @@ def _hetero_padding_rows():
         batch = mb * M
         rows.append(Result(
             f"hetero_wire_{wire_name}_S{S}", dt, batch / dt, "img/s",
-            bool(np.isfinite(losses[wire_name])), 0.0,
+            bool(np.isfinite(losses[wire_name])) and wire_exact, 0.0,
             extra={"stages": S, "wire_bytes_per_hop": int(shipped),
                    "padding_overhead_x": round(float(overhead), 2),
+                   "hlo_wire_widths_exact": wire_exact,
                    "model": pipe.model.name}))
     # bf16 wire must track fp32 loss to bf16 tolerance
     rows[-1].correct = bool(abs(losses["bf16"] - losses["fp32"]) < 0.05)
