@@ -1,0 +1,114 @@
+"""Pallas implicit-GEMM conv vs XLA conv on the ResNet-18 shape class.
+
+The VERDICT-r3 top-item experiment: ResNet-18's conv fusions run at ~55% MXU
+while active (xprof, RESULTS.md) — is a hand-written implicit-GEMM conv
+faster, or is 55% the shape's ceiling? Each row races
+`dcnn_tpu.ops.pallas.conv.conv3x3_s1` (batch-tile swept) against
+`lax.conv_general_dilated` on one (B, H, W, Cin->Cout) 3x3 stride-1 bf16
+shape with the chained-timing harness; correctness-gated vs XLA at fp32
+tolerance. Run on TPU (`python bench_pallas_conv.py`); results feed
+RESULTS.md either as the win + dispatch rule or as the documented negative
+result that closes the claim (reference kernel family:
+``src/nn/layers_impl/cuda/conv2d_ops.cu``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from common import Result, print_table, report, time_chained, tiny_mode  # noqa: E402
+
+
+def _shapes():
+    if tiny_mode():
+        return [(8, 8, 8, 16, 16)]
+    # (B, H, W, Cin, Cout): the ResNet-18 Tiny-ImageNet 3x3-s1 bodies
+    return [
+        (256, 64, 64, 64, 64),     # layer1 (B capped to keep VMEM/HBM sane)
+        (256, 32, 32, 128, 128),   # layer2
+        (256, 16, 16, 256, 256),   # layer3
+        (256, 8, 8, 512, 512),     # layer4
+    ]
+
+
+def run():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1, conv3x3_s1_pairs
+
+    results = []
+    rng = np.random.default_rng(0)
+    for (b, h, w, cin, cout) in _shapes():
+        x = jnp.asarray(rng.normal(size=(b, h, w, cin)), jnp.bfloat16)
+        wt = jnp.asarray(rng.normal(size=(3, 3, cin, cout)) * 0.05,
+                         jnp.bfloat16)
+        flops = 2 * b * h * w * 9 * cin * cout
+
+        def feed(out, args):
+            # thread output back: re-scale into the input's magnitude
+            xx, ww_ = args
+            return (out[..., :cin].astype(jnp.bfloat16) * 0.001 + xx, ww_)
+
+        def xla_conv(xx, ww_):
+            return lax.conv_general_dilated(
+                xx, ww_, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+        ref = np.asarray(xla_conv(x, wt), np.float32)
+        dt_xla = time_chained(xla_conv, (x, wt), feed)
+        results.append(Result(
+            f"xla_conv_{h}x{w}x{cin}", dt_xla, flops / dt_xla / 1e12,
+            "TF/s", True, 0.0, extra={"B": b}))
+
+        variants = {"pallas_conv": lambda xx, ww_, _bt: conv3x3_s1(
+            xx, ww_, batch_tile=_bt)}
+        if cout < 128 and w % 2 == 0:
+            # narrow-Cout shapes: also race the output-column-pair
+            # formulation (N = 2K fills the MXU width K alone leaves idle)
+            variants["pallas_conv_pairs"] = lambda xx, ww_, _bt: \
+                conv3x3_s1_pairs(xx, ww_, batch_tile=_bt)
+        for vname, fn in variants.items():
+            best = None
+            for bt in (1, 2, 4, 8):
+                if b % bt:
+                    continue
+                try:
+                    def pk(xx, ww_, _bt=bt, _fn=fn):
+                        return _fn(xx, ww_, _bt)
+                    got = np.asarray(pk(x, wt), np.float32)
+                    err = float(np.max(np.abs(got - ref)))
+                    ok = err < 0.75  # bf16 on K up to 4608
+                    dt = time_chained(pk, (x, wt), feed)
+                    if best is None or dt < best[0]:
+                        best = (dt, bt, ok, err)
+                except Exception as e:  # noqa: BLE001 — record, keep going.
+                    # correct=None: an infeasible batch_tile (VMEM overflow)
+                    # is sweep information, not a correctness failure — it
+                    # must not flip all_correct when another bt passes
+                    results.append(Result(
+                        f"{vname}_{h}x{w}x{cin}_bt{bt}_FAILED", 0.0, 0.0,
+                        "TF/s", None, None,
+                        extra={"error": str(e)[:200]}))
+            if best:
+                dt, bt, ok, err = best
+                results.append(Result(
+                    f"{vname}_{h}x{w}x{cin}", dt, flops / dt / 1e12,
+                    "TF/s", ok, err,
+                    extra={"B": b, "batch_tile": bt,
+                           "vs_xla": round(dt_xla / dt, 3)}))
+    return report("pallas_conv", results)
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

@@ -9,6 +9,9 @@ from .regression import RegressionDataLoader
 from .wifi import UJIWiFiDataLoader
 from .synthetic import SyntheticClassificationLoader
 from .prefetch import PrefetchLoader
+from .streaming import (
+    StreamingDeviceDataset, make_shard_step, train_streaming_epoch,
+)
 from .augment import (
     AugmentationBuilder, AugmentationStrategy,
     brightness, contrast, cutout, gaussian_noise, horizontal_flip,
@@ -27,6 +30,7 @@ __all__ = [
     "TinyImageNetDataLoader", "RegressionDataLoader", "UJIWiFiDataLoader",
     "SyntheticClassificationLoader",
     "PrefetchLoader",
+    "StreamingDeviceDataset", "make_shard_step", "train_streaming_epoch",
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",

@@ -119,6 +119,26 @@ def _decode(x, scale, compute_dtype):
     return x.astype(cdt) * jnp.asarray(scale, cdt)
 
 
+def make_batch_scan_body(base, x_all, y_all, *, num_classes, scale, cdt,
+                         augment, kstep):
+    """The gather → decode → augment → one-hot → train-step scan body, as
+    ONE definition shared by the resident (this module) and streaming
+    (``data/streaming.py``) feed paths — cross-path numerics parity
+    (per-step rng fold-in, the 0x0A6 augment-key offset, decode scaling)
+    depends on these staying identical. ``scan_in`` = (batch_indices,
+    step_index, lr)."""
+    def body(carry, scan_in):
+        bidx, i, lr_i = scan_in
+        xb = _decode(x_all[bidx], scale, cdt)
+        key = jax.random.fold_in(kstep, i)
+        if augment is not None:
+            xb = augment(xb, jax.random.fold_in(key, 0x0A6))
+        yb = jax.nn.one_hot(y_all[bidx], num_classes, dtype=jnp.float32)
+        new_ts, loss, _ = base(carry, xb, yb, key, lr_i)
+        return new_ts, loss
+    return body
+
+
 def make_resident_epoch(model, loss_fn: Callable, optimizer, *,
                         num_classes: int, batch_size: int,
                         augment: Optional[Callable] = None,
@@ -159,17 +179,9 @@ def make_resident_epoch(model, loss_fn: Callable, optimizer, *,
             for r in range(reps)])
         idx = perm[:need].reshape(k, batch_size)
         lrs = jnp.broadcast_to(jnp.asarray(lr, jnp.float32), (k,))
-
-        def body(carry, scan_in):
-            bidx, i, lr_i = scan_in
-            xb = _decode(x_all[bidx], scale, cdt)
-            key = jax.random.fold_in(kstep, i)
-            if augment is not None:
-                xb = augment(xb, jax.random.fold_in(key, 0x0A6))
-            yb = jax.nn.one_hot(y_all[bidx], num_classes, dtype=jnp.float32)
-            new_ts, loss, _ = base(carry, xb, yb, key, lr_i)
-            return new_ts, loss
-
+        body = make_batch_scan_body(base, x_all, y_all,
+                                    num_classes=num_classes, scale=scale,
+                                    cdt=cdt, augment=augment, kstep=kstep)
         ts, losses = jax.lax.scan(body, ts, (idx, jnp.arange(k), lrs))
         return ts, jnp.mean(losses)
 

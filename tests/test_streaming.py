@@ -1,0 +1,103 @@
+"""Streaming feed path tests (data/streaming.py — VERDICT r3 missing #6):
+shard-step numerics parity with manual base steps, double-buffered epoch
+semantics, and geometry validation."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.data import StreamingDeviceDataset, make_shard_step, \
+    train_streaming_epoch, one_hot
+from dcnn_tpu.nn.builder import SequentialBuilder
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.train.trainer import create_train_state, make_train_step
+
+
+def _model(n_classes=4, hw=8):
+    return (SequentialBuilder(name="stream_cnn", data_format="NHWC")
+            .input((hw, hw, 1))
+            .conv2d(8, 3, padding=1).batchnorm().activation("relu")
+            .flatten().dense(n_classes)
+            .build())
+
+
+def _blobs(n, hw=8, n_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, size=n)
+    base = (y[:, None, None, None] * 50 + 20).astype(np.float32)
+    x = np.clip(base + rng.normal(0, 10, size=(n, hw, hw, 1)), 0, 255)
+    return x.astype(np.uint8), y.astype(np.int64)
+
+
+def test_shard_step_matches_manual_steps():
+    """One shard dispatch == K manual base-step calls over the same
+    permutation/rng derivation (same pattern the resident engine pins)."""
+    x, y = _blobs(n=24)
+    model = _model()
+    opt = SGD(0.05)
+    key = jax.random.PRNGKey(3)
+    ts0 = create_train_state(model, opt, key)
+    ts0b = create_train_state(model, opt, key)
+
+    K, B = 3, 8
+    step = make_shard_step(model, softmax_cross_entropy, opt, num_classes=4,
+                           batch_size=B, shard_batches=K)
+    rng = jax.random.PRNGKey(7)
+    xs = jnp.asarray(x)
+    ys = jnp.asarray(y.astype(np.int32))
+    ts1, mean_loss = step(ts0, xs, ys, rng, 0.05)
+
+    kperm, kstep = jax.random.split(rng)
+    idx = np.asarray(jax.random.permutation(kperm, K * B)).reshape(K, B)
+    base = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    losses = []
+    ts = ts0b
+    for i in range(K):
+        xb = jnp.asarray(x[idx[i]].astype(np.float32) / 255.0)
+        yb = jnp.asarray(one_hot(y[idx[i]], 4))
+        ts, loss, _ = base(ts, xb, yb, jax.random.fold_in(kstep, i), 0.05)
+        losses.append(float(loss))
+
+    assert float(mean_loss) == pytest.approx(np.mean(losses), abs=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts1.params),
+                    jax.tree_util.tree_leaves(ts.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_streaming_epoch_trains_and_covers_shards():
+    x, y = _blobs(n=70, seed=1)            # 2 full shards of 32, 6 dropped
+    model = _model()
+    opt = SGD(0.05)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    ds = StreamingDeviceDataset(x, y, 4, batch_size=8, shard_batches=4)
+    assert ds.num_shards == 2 and ds.steps_per_epoch == 8
+    step = make_shard_step(model, softmax_cross_entropy, opt, num_classes=4,
+                           batch_size=8, shard_batches=4)
+    losses = []
+    for epoch in range(4):
+        ts, loss = train_streaming_epoch(step, ts, ds,
+                                         jax.random.PRNGKey(epoch), 0.05)
+        losses.append(loss)
+    assert losses[-1] < losses[0]          # separable blobs learn quickly
+    # epoch shard membership rotates (remainder handling): two epochs'
+    # shard contents differ
+    s1 = [ys.tobytes() for _, ys in ds.shards()]
+    s2 = [ys.tobytes() for _, ys in ds.shards()]
+    assert s1 != s2
+
+
+def test_streaming_geometry_validation():
+    x, y = _blobs(n=30)
+    with pytest.raises(ValueError, match="smaller than one shard"):
+        StreamingDeviceDataset(x, y, 4, batch_size=8, shard_batches=4)
+    model = _model()
+    step = make_shard_step(model, softmax_cross_entropy, SGD(0.05),
+                           num_classes=4, batch_size=8, shard_batches=4)
+    ts = create_train_state(model, SGD(0.05), jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="exactly"):
+        step(ts, jnp.asarray(x[:24]), jnp.asarray(y[:24].astype(np.int32)),
+             jax.random.PRNGKey(1), 0.05)

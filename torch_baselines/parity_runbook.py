@@ -1,0 +1,242 @@
+"""Accuracy-parity runbook: dcnn_tpu vs PyTorch on real datasets.
+
+The committed, scripted procedure VERDICT r3 item 3b asks for: the moment a
+dataset is present (this build environment has zero egress; fetch on a
+connected host with ``python -m dcnn_tpu.data.download --root data <name>``
+and copy ``data/`` over), one command trains the SAME architecture with the
+SAME optimizer/schedule in BOTH frameworks and records top-1 side by side:
+
+    python torch_baselines/parity_runbook.py [mnist cifar10 tiny_imagenet]
+
+Per dataset: torch model (independent definitions mirroring
+``dcnn_tpu/models/zoo.py`` — themselves mirrors of the reference
+``include/nn/example_models.hpp``) trains on torch's loader of the same
+files; the dcnn_tpu model trains through ``examples/accuracy_gates.py``
+machinery. Pass = |top1_jax - top1_torch| <= tolerance (default 1.0 pt) AND
+both beat the gate floor. Results append to ``PARITY.json`` at the repo root.
+
+Reference training semantics being reproduced: ``include/nn/train.hpp:202-308``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "examples"))
+
+TOL_PTS = float(os.environ.get("PARITY_TOL_PTS", "1.0"))
+
+
+# ---------------------------------------------------------------- torch side
+
+def _torch_mnist_model():
+    import torch.nn as nn
+    return nn.Sequential(                       # zoo.create_mnist_trainer
+        nn.Conv2d(1, 8, 5), nn.BatchNorm2d(8, eps=1e-5), nn.ReLU(),
+        nn.MaxPool2d(3, 3),
+        nn.Conv2d(8, 16, 1), nn.BatchNorm2d(16, eps=1e-5), nn.ReLU(),
+        nn.Conv2d(16, 48, 5), nn.BatchNorm2d(48, eps=1e-5), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        nn.Flatten(), nn.Linear(48 * 2 * 2, 10))
+
+
+def _torch_resnet9():
+    import torch.nn as nn
+
+    class Block(nn.Module):                     # basic_residual_block(c, c, 1)
+        def __init__(self, c):
+            super().__init__()
+            self.c0 = nn.Conv2d(c, c, 3, 1, 1, bias=True)
+            self.b0 = nn.BatchNorm2d(c, eps=1e-5)
+            self.c1 = nn.Conv2d(c, c, 3, 1, 1, bias=True)
+            self.b1 = nn.BatchNorm2d(c, eps=1e-5)
+            self.r = nn.ReLU()
+
+        def forward(self, x):
+            h = self.r(self.b0(self.c0(x)))
+            h = self.b1(self.c1(h))
+            return self.r(h + x)
+
+    return nn.Sequential(                       # zoo.create_resnet9_cifar10
+        nn.Conv2d(3, 64, 3, 1, 1), nn.BatchNorm2d(64, eps=1e-5), nn.ReLU(),
+        nn.Conv2d(64, 128, 3, 1, 1), nn.BatchNorm2d(128, eps=1e-5), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        Block(128), Block(128),
+        nn.Conv2d(128, 256, 3, 1, 1), nn.BatchNorm2d(256, eps=1e-5), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        Block(256), Block(256),
+        nn.Conv2d(256, 512, 3, 1, 1), nn.BatchNorm2d(512, eps=1e-5), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        Block(512),
+        nn.AvgPool2d(4, 1),
+        nn.Flatten(), nn.Linear(512, 10))
+
+
+def _torch_resnet18_tiny():
+    from resnet18_tiny import ResNet18Tiny  # noqa: E501 — sibling module
+    return ResNet18Tiny()
+
+
+def _train_torch(model, train_xy, val_xy, *, epochs, lr, batch):
+    """Plain Adam + softmax-CE loop — the exact recipe the dcnn_tpu gates
+    use (train.hpp:202-308 semantics)."""
+    import torch
+    import torch.nn as nn
+    from torch.utils.data import DataLoader, TensorDataset
+
+    dev = "cuda" if torch.cuda.is_available() else "cpu"
+    model = model.to(dev)
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    lossf = nn.CrossEntropyLoss()
+    tl = DataLoader(TensorDataset(*train_xy), batch_size=batch, shuffle=True)
+    vl = DataLoader(TensorDataset(*val_xy), batch_size=512)
+    for _ in range(epochs):
+        model.train()
+        for xb, yb in tl:
+            opt.zero_grad()
+            loss = lossf(model(xb.to(dev)), yb.to(dev))
+            loss.backward()
+            opt.step()
+    model.eval()
+    hit = n = 0
+    with torch.no_grad():
+        for xb, yb in vl:
+            hit += (model(xb.to(dev)).argmax(1).cpu() == yb).sum().item()
+            n += len(yb)
+    return hit / n
+
+
+# ---------------------------------------------------------------- datasets
+
+def _load_mnist():
+    from dcnn_tpu.data import MNISTDataLoader
+    paths = [os.path.join(ROOT, "data/mnist", f) for f in
+             ("train.csv", "test.csv")]
+    if not all(os.path.isfile(p) for p in paths):
+        return None
+    import torch
+    out = []
+    for p in paths:
+        ld = MNISTDataLoader(p, data_format="NCHW", batch_size=128,
+                             shuffle=False)
+        ld.load_data()
+        out.append((torch.from_numpy(ld._x.copy()),
+                    torch.from_numpy(ld._y.argmax(-1).astype("int64"))
+                    if ld._y.ndim == 2 else
+                    torch.from_numpy(ld._y.astype("int64"))))
+    return out
+
+
+def _load_cifar10():
+    from dcnn_tpu.data import CIFAR10DataLoader
+    root = os.path.join(ROOT, "data/cifar-10-batches-bin")
+    if not os.path.isdir(root):
+        return None
+    import torch
+    train = CIFAR10DataLoader(
+        [f"{root}/data_batch_{i}.bin" for i in range(1, 6)],
+        batch_size=128, shuffle=False)
+    val = CIFAR10DataLoader(f"{root}/test_batch.bin", batch_size=512,
+                            shuffle=False)
+    train.load_data(); val.load_data()
+
+    def t(ld):
+        y = ld._y.argmax(-1) if ld._y.ndim == 2 else ld._y
+        return (torch.from_numpy(ld._x.copy()),
+                torch.from_numpy(y.astype("int64")))
+    return [t(train), t(val)]
+
+
+def _load_tiny():
+    from dcnn_tpu.data import TinyImageNetDataLoader
+    root = os.path.join(ROOT, "data/tiny-imagenet-200")
+    if not os.path.isdir(root):
+        return None
+    import torch
+    train = TinyImageNetDataLoader(root, split="train", batch_size=128,
+                                   shuffle=False, data_format="NCHW")
+    val = TinyImageNetDataLoader(root, split="val", batch_size=512,
+                                 shuffle=False, data_format="NCHW")
+    train.load_data(); val.load_data()
+
+    def t(ld):
+        y = ld._y.argmax(-1) if ld._y.ndim == 2 else ld._y
+        return (torch.from_numpy(ld._x.copy()),
+                torch.from_numpy(y.astype("int64")))
+    return [t(train), t(val)]
+
+
+# ---------------------------------------------------------------- gates
+
+GATES = {
+    # name: (loader, torch model, jax gate fn name in accuracy_gates,
+    #        epochs env, default epochs, lr, floor)
+    "mnist": (_load_mnist, _torch_mnist_model, "gate_mnist",
+              "EPOCHS_MNIST", 12, 1e-3, 0.99),
+    "cifar10": (_load_cifar10, _torch_resnet9, "gate_cifar10",
+                "EPOCHS_CIFAR10", 20, 1e-3, 0.0),
+    "tiny_imagenet": (_load_tiny, _torch_resnet18_tiny, "gate_tiny_imagenet",
+                      "EPOCHS_TINY", 30, 1e-3, 0.0),
+}
+
+
+def main():
+    names = sys.argv[1:] or list(GATES)
+    records = []
+    for name in names:
+        load, torch_model, jax_gate, eenv, edef, lr, floor = GATES[name]
+        data = load()
+        if data is None:
+            records.append({"dataset": name, "skipped":
+                            "dataset absent; fetch with: python -m "
+                            f"dcnn_tpu.data.download --root data {name}"})
+            print(f"[{name}] SKIPPED (dataset absent)")
+            continue
+        epochs = int(os.environ.get(eenv, str(edef)))
+        t0 = time.time()
+        torch_top1 = _train_torch(torch_model(), data[0], data[1],
+                                  epochs=epochs, lr=lr, batch=128)
+        torch_wall = time.time() - t0
+
+        import accuracy_gates
+        gate_fn = getattr(accuracy_gates, jax_gate, None)
+        if gate_fn is None:
+            records.append({"dataset": name,
+                            "skipped": f"no jax gate {jax_gate}"})
+            continue
+        t0 = time.time()
+        jax_rec = gate_fn()
+        jax_wall = time.time() - t0
+        jax_top1 = jax_rec.get("val_acc")
+        delta = (jax_top1 - torch_top1) * 100 if jax_top1 is not None else None
+        rec = {"dataset": name, "epochs": epochs,
+               "torch_top1": round(torch_top1, 4),
+               "jax_top1": (round(jax_top1, 4)
+                            if jax_top1 is not None else None),
+               "delta_pts": round(delta, 2) if delta is not None else None,
+               "parity": (delta is not None and abs(delta) <= TOL_PTS
+                          and (jax_top1 or 0) >= floor),
+               "torch_wall_s": round(torch_wall, 1),
+               "jax_wall_s": round(jax_wall, 1)}
+        records.append(rec)
+        print(f"[{name}] torch {torch_top1:.4f} vs jax {jax_top1} "
+              f"(delta {rec['delta_pts']} pts, parity={rec['parity']})")
+
+    out = os.path.join(ROOT, "PARITY.json")
+    existing = []
+    if os.path.exists(out):
+        with open(out) as f:
+            existing = json.load(f)
+    existing.extend(records)
+    with open(out, "w") as f:
+        json.dump(existing, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
