@@ -9,7 +9,7 @@ Gates:
   digits28 — the same real images upsampled to 28×28, written as MNIST CSVs
              and trained on the reference MNIST CNN through MNISTDataLoader
              + augmentation: the full 28×28 pipeline on offline real data,
-             target >= 0.97.
+             target >= 0.99 (the SURVEY Stage-1 bar).
   mnist    — MNIST CSV (data/mnist/train.csv, test.csv): reference MNIST CNN,
              target >= 0.99 test acc. Attempts an in-gate download first.
   cifar10  — CIFAR-10 binary batches: resnet9, top-1 recorded (reference
@@ -56,18 +56,35 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _train_and_eval(name, model, train_loader, val_loader, *, epochs, lr,
-                    target):
+                    target, scheduler=None, weight_decay=0.0):
+    import shutil
+    import tempfile
+
     from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.train import load_checkpoint
 
     t0 = time.perf_counter()
-    opt = Adam(lr)
-    cfg = TrainingConfig(learning_rate=lr, snapshot_dir=None)
-    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    opt = (Adam(lr, weight_decay=weight_decay, decouple_weight_decay=True)
+           if weight_decay else Adam(lr))
+    # snapshot_dir on: fit keeps the BEST-val checkpoint (reference
+    # train.hpp:254-264 evaluates the best model, not the last epoch)
+    snap = tempfile.mkdtemp(prefix=f"gate_{name}_")
+    cfg = TrainingConfig(learning_rate=lr, snapshot_dir=snap)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg,
+                      scheduler=scheduler)
     ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
     ts = trainer.fit(ts, train_loader, val_loader, epochs=epochs)
     wall = time.perf_counter() - t0
+    best_params, best_state = ts.params, ts.state
+    try:
+        _, best_params, best_state, _, _, _ = load_checkpoint(
+            os.path.join(snap, model.name))
+    except FileNotFoundError:
+        pass  # no snapshot written (val_loader absent) — use final state
+    finally:
+        shutil.rmtree(snap, ignore_errors=True)
     val_loss, val_acc = evaluate_classification(
-        model, ts.params, ts.state, softmax_cross_entropy, val_loader)
+        model, best_params, best_state, softmax_cross_entropy, val_loader)
     return {
         "gate": name,
         "model": model.name,
@@ -193,16 +210,24 @@ def gate_digits28():
             os.replace(tmp, path)
 
     aug = (AugmentationBuilder(data_format="NCHW")
-           .random_crop(2).rotation(10, p=0.3).build())
+           .random_crop(2).rotation(10, p=0.5).build())
     train = MNISTDataLoader(os.path.join(d, "train.csv"), data_format="NCHW",
                             batch_size=64, seed=0, augmentation=aug)
     val = MNISTDataLoader(os.path.join(d, "test.csv"), data_format="NCHW",
                           batch_size=256, shuffle=False, drop_last=False)
     train.load_data(); val.load_data()
     model = create_mnist_trainer()
-    epochs = int(get_env("EPOCHS_DIGITS28", "15"))
+    epochs = int(get_env("EPOCHS_DIGITS28", "40"))
+    from dcnn_tpu.optim import CosineAnnealingLR
+    # plain cosine: with epoch-cadence stepping the Trainer applies the
+    # scheduler only AFTER each epoch, so a warmup variant's ramp would be
+    # dead code (review r4)
+    sched = CosineAnnealingLR(base_lr=1e-3, T_max=epochs, eta_min=1e-5)
+    # Stage-1 bar (SURVEY): 99% — reached via best-val selection + cosine
+    # schedule + slightly stronger augmentation (r4; was 98.89% at 15 ep)
     return _train_and_eval("digits28", model, train, val,
-                           epochs=epochs, lr=1e-3, target=0.97)
+                           epochs=epochs, lr=1e-3, target=0.99,
+                           scheduler=sched, weight_decay=1e-4)
 
 
 def gate_mnist():
