@@ -100,12 +100,34 @@ def lib() -> Optional[ctypes.CDLL]:
             getattr(l, fn).restype = ctypes.c_int64
         l.dcnn_lz4_compress_bound.argtypes = [ctypes.c_int64]
         l.dcnn_lz4_compress_bound.restype = ctypes.c_int64
+    if hasattr(l, "dcnn_byte_shuffle"):
+        for fn in ("dcnn_byte_shuffle", "dcnn_byte_unshuffle"):
+            getattr(l, fn).argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64, ctypes.c_int32]
+            getattr(l, fn).restype = ctypes.c_int
     _lib = l
     return _lib
 
 
 def _u8ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def byte_shuffle(data: bytes, typesize: int,
+                 inverse: bool = False) -> Optional[bytes]:
+    """Blosc-style byte-plane (un)shuffle. None if the lib is unavailable;
+    raises on length % typesize != 0."""
+    l = lib()
+    if l is None or not hasattr(l, "dcnn_byte_shuffle"):
+        return None
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(len(data), np.uint8)
+    fn = l.dcnn_byte_unshuffle if inverse else l.dcnn_byte_shuffle
+    if fn(_u8ptr(src), _u8ptr(dst), src.size, typesize) != 0:
+        raise ValueError(f"byte_shuffle: {len(data)} % typesize {typesize}")
+    return dst.tobytes()
 
 
 def lz4_available() -> bool:

@@ -9,9 +9,11 @@ messages (``docs/pipeline_architecture.md:8``).
 On TPU intra-slice transfers ride ICI and are never compressed; compression
 matters only for host-path/DCN transfers (checkpoint shipping, cross-site
 coordination). Available codecs here: zstd (preferred; same default codec as
-the reference), zlib (always present), and LZ4 block format via the native
-C++ library (``native/src/lz4codec.cpp`` — the reference's Lz4hcCompressor
-slot). A ``MetaCompressor`` dispatches by codec id, wire-compatible layout:
+the reference), zlib (always present), LZ4 block format via the native C++
+library (``native/src/lz4codec.cpp`` — the reference's Lz4hcCompressor
+slot), and byte-shuffle+zstd (``native/src/shuffle.cpp`` — the reference's
+BloscCompressor slot: Blosc's core transform is the byte-plane shuffle). A
+``MetaCompressor`` dispatches by codec id, wire-compatible layout:
 ``[1-byte codec id][u64 raw size][payload]``.
 """
 
@@ -104,6 +106,41 @@ class Lz4Compressor:
         return self._n.lz4_decompress(data, raw_size)
 
 
+class ShuffleZstdCompressor:
+    """Blosc-analog codec (reference ``BloscCompressor``,
+    ``internal_compressor.hpp:5-15``): byte-plane shuffle (native C++)
+    then zstd. The shuffle groups each byte position of fixed-size numeric
+    elements contiguously — exponent/sign planes of float tensors are
+    highly correlated, so zstd-after-shuffle typically beats plain zstd on
+    fp32/bf16 payloads. Payload layout: ``[1-byte typesize][shuffled
+    stream]`` so decompression is self-describing."""
+
+    codec_id = 4
+
+    def __init__(self, typesize: int = 4, level: int = 3):
+        from .. import native as _native
+        if not 1 <= int(typesize) <= 255:
+            raise ValueError(f"typesize must be 1..255 (1-byte payload "
+                             f"header), got {typesize}")
+        if _zstd is None:
+            raise RuntimeError("zstandard not available")
+        if _native.byte_shuffle(b"", 1) is None:
+            raise RuntimeError("native shuffle unavailable (no toolchain)")
+        self._n = _native
+        self.typesize = int(typesize)
+        self._c = _zstd.ZstdCompressor(level=level)
+        self._d = _zstd.ZstdDecompressor()
+
+    def compress(self, data: bytes) -> bytes:
+        t = self.typesize if len(data) % self.typesize == 0 else 1
+        return self._c.compress(
+            bytes([t]) + self._n.byte_shuffle(data, t))
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        raw = self._d.decompress(data, max_output_size=(raw_size or 2**31) + 1)
+        return self._n.byte_shuffle(raw[1:], raw[0], inverse=True)
+
+
 class MetaCompressor:
     """Codec-id-framed dispatch (reference ``meta_compressor.hpp:10-35``)."""
 
@@ -134,11 +171,16 @@ class MetaCompressor:
 
     def decompress(self, blob: bytes) -> bytes:
         codec_id, raw_size = self._HEADER.unpack_from(blob)
-        if codec_id == Lz4Compressor.codec_id and codec_id not in self.codecs:
-            try:
-                self.register(Lz4Compressor())
-            except RuntimeError:
-                pass
+        if codec_id not in self.codecs:
+            # native-backed codecs register lazily (constructing them may
+            # trigger the g++ build; MetaCompressor() runs at import time)
+            lazy = {Lz4Compressor.codec_id: Lz4Compressor,
+                    ShuffleZstdCompressor.codec_id: ShuffleZstdCompressor}
+            if codec_id in lazy:
+                try:
+                    self.register(lazy[codec_id]())
+                except RuntimeError:
+                    pass
         if codec_id not in self.codecs:
             raise ValueError(f"unknown codec id {codec_id}")
         return self.codecs[codec_id].decompress(blob[self._HEADER.size:], raw_size)

@@ -69,19 +69,22 @@ def _train_and_eval(name, model, train_loader, val_loader, *, epochs, lr,
     # snapshot_dir on: fit keeps the BEST-val checkpoint (reference
     # train.hpp:254-264 evaluates the best model, not the last epoch)
     snap = tempfile.mkdtemp(prefix=f"gate_{name}_")
-    cfg = TrainingConfig(learning_rate=lr, snapshot_dir=snap)
-    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg,
-                      scheduler=scheduler)
-    ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
-    ts = trainer.fit(ts, train_loader, val_loader, epochs=epochs)
-    wall = time.perf_counter() - t0
-    best_params, best_state = ts.params, ts.state
     try:
-        _, best_params, best_state, _, _, _ = load_checkpoint(
-            os.path.join(snap, model.name))
-    except FileNotFoundError:
-        pass  # no snapshot written (val_loader absent) — use final state
+        cfg = TrainingConfig(learning_rate=lr, snapshot_dir=snap)
+        trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg,
+                          scheduler=scheduler)
+        ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
+        ts = trainer.fit(ts, train_loader, val_loader, epochs=epochs)
+        wall = time.perf_counter() - t0
+        best_params, best_state = ts.params, ts.state
+        try:
+            _, best_params, best_state, _, _, _ = load_checkpoint(
+                os.path.join(snap, model.name))
+        except FileNotFoundError:
+            pass  # no snapshot written (val_loader absent) — use final state
     finally:
+        # the dir must not outlive the gate even if fit raises: it holds a
+        # full model+opt-state checkpoint on a storage-constrained host
         shutil.rmtree(snap, ignore_errors=True)
     val_loss, val_acc = evaluate_classification(
         model, best_params, best_state, softmax_cross_entropy, val_loader)

@@ -161,3 +161,35 @@ def test_lz4_via_meta_compressor():
     assert blob[0] == 3
     assert mc.decompress(blob) == payload  # lazily registered on first id-3
     assert 3 in mc.codecs
+
+
+# -- byte-shuffle filter + Blosc-analog codec (shuffle.cpp) --
+
+@requires_native
+def test_byte_shuffle_roundtrip_and_layout(rng):
+    data = np.arange(40, dtype=np.uint8).tobytes()
+    sh = native.byte_shuffle(data, 4)
+    # plane 0 = every 4th byte starting at 0
+    assert sh[:10] == bytes(range(0, 40, 4))
+    assert native.byte_shuffle(sh, 4, inverse=True) == data
+    with pytest.raises(ValueError):
+        native.byte_shuffle(b"12345", 4)   # 5 % 4 != 0
+
+
+@requires_native
+def test_shuffle_zstd_codec_beats_plain_zstd_on_floats(rng):
+    from dcnn_tpu.utils.compression import (
+        MetaCompressor, ShuffleZstdCompressor, ZstdCompressor)
+
+    # smooth float data: byte-plane correlation is what the shuffle exploits
+    payload = np.cumsum(rng.normal(size=50_000)).astype(np.float32).tobytes()
+    mc = MetaCompressor()
+    blob = mc.compress(payload, ShuffleZstdCompressor(typesize=4))
+    assert blob[0] == 4
+    assert mc.decompress(blob) == payload      # lazy registration path
+    plain = mc.compress(payload, ZstdCompressor())
+    assert len(blob) < len(plain), (len(blob), len(plain))
+    # non-multiple-of-typesize payloads fall back to typesize 1
+    odd = payload[:4093]
+    blob2 = mc.compress(odd, ShuffleZstdCompressor(typesize=4))
+    assert mc.decompress(blob2) == odd
