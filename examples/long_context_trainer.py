@@ -1,0 +1,166 @@
+"""Long-context training demo: causal self-attention at real sequence
+lengths through the Pallas flash fwd+bwd kernels, on one chip.
+
+The committed form of the r3/r4 long-context demonstrations (RESULTS.md
+"Long-context subsystem"): a 2-block causal self-attention stack trains on
+the **position-marker retrieval task** — each sequence carries one marked
+position whose token identity is the label, so the readout must attend
+across (almost) the whole context to answer. Random guessing = 1/num_classes;
+solving it requires genuine long-range attention, exercising the flash
+forward AND the hand-written dq/dk/dv backward end-to-end.
+
+No reference analog (the reference is CNN-only, SURVEY.md §5.7) — this is
+the framework's long-context capability as a runnable artifact.
+
+Env: SEQ_LEN (default 2048), EMBED (128), HEADS (2 — head_dim 64 is the
+lane-friendly TPU shape; head_dim 16 from HEADS=8 trips a marginal VMEM
+overflow in the flash backward at S=8192), BATCH (32), STEPS_PER_EPOCH
+(60), EPOCHS (8), NUM_CLASSES (16).
+
+Measured (v5e, bf16): defaults (S=2048, B=32) reach 100% fresh-data
+accuracy by epoch 5 at ~34-49 ms/step (1.34-1.95M tokens/s);
+SEQ_LEN=8192 BATCH=8 trains at ~35 ms/step = 1.88M tokens/s.
+On CPU the flash kernels run in interpret mode — keep SEQ_LEN small there
+(e.g. SEQ_LEN=128 for a smoke run).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+from common import setup
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.nn.attention_layer import MultiHeadAttentionLayer
+from dcnn_tpu.nn.residual import ResidualBlock
+from dcnn_tpu.optim import Adam
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.train.trainer import create_train_state, make_train_step
+from dcnn_tpu.utils.env import get_env
+
+
+def make_trunk(seq_len: int, embed: int, heads: int):
+    """2 residual causal-attention blocks; the classifier head is built in
+    main on the pooled readout."""
+    def attn_block(name: str) -> ResidualBlock:
+        return ResidualBlock(
+            layers=[MultiHeadAttentionLayer(num_heads=heads, causal=True,
+                                            impl="flash", name=f"{name}_mha")],
+            shortcut=[], activation="relu", name=name)
+
+    return (SequentialBuilder("long_context_mha")
+            .input((seq_len, embed))
+            .add_layer(attn_block("attn0"))
+            .add_layer(attn_block("attn1"))
+            .build())
+
+
+def make_device_batch(key, batch: int, seq_len: int, embed: int,
+                      num_classes: int):
+    """Position-marker retrieval, generated ON DEVICE (fused into the train
+    dispatch — zero H2D, fresh sequences every step, so train accuracy IS
+    generalization): token embeddings are random; one position p < S-64
+    carries the MARKER flag (channel 0 high) and a class id encoded on
+    channels 1..num_classes; the label is that class. The model must route
+    the marked token's identity across the context to the readout."""
+    kx, kp, kc = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (batch, seq_len, embed)) * 0.3
+    pos = jax.random.randint(kp, (batch,), 0, seq_len - 64)
+    cls = jax.random.randint(kc, (batch,), 0, num_classes)
+    at_marker = jax.nn.one_hot(pos, seq_len) * 4.0            # (B, S)
+    payload = (at_marker[:, :, None] *
+               (jax.nn.one_hot(0, embed) +
+                jax.nn.one_hot(1 + cls, embed)[:, None, :]))
+    return x + payload, jax.nn.one_hot(cls, num_classes)
+
+
+def main():
+    cfg = setup("long_context")
+    S = int(get_env("SEQ_LEN", 2048))
+    E = int(get_env("EMBED", 128))
+    H = int(get_env("HEADS", 2))
+    B = int(get_env("BATCH", 32))
+    steps = int(get_env("STEPS_PER_EPOCH", 60))
+    epochs = int(get_env("EPOCHS", 8))
+    nc = int(get_env("NUM_CLASSES", 16))
+
+    trunk = make_trunk(S, E, H)
+
+    # head on the pooled last-32 readout, trained jointly
+    head = (SequentialBuilder("lc_head").input((E,))
+            .dense(nc, True, "cls").build())
+
+    opt = Adam(cfg.learning_rate)
+    key = jax.random.PRNGKey(cfg.seed)
+    tp, tstate = trunk.init(key)
+    hp, hstate = head.init(jax.random.fold_in(key, 1))
+
+    class Joint:
+        """Minimal Sequential-like wrapper: trunk -> mean(last 32) -> head."""
+        name = "long_context_joint"
+
+        def init(self, k, input_shape=None):
+            return ({"t": tp, "h": hp}, {"t": tstate, "h": hstate})
+
+        def apply(self, params, state, x, *, training=False, rng=None):
+            z, ts_new = trunk.apply(params["t"], state["t"], x,
+                                    training=training, rng=rng)
+            # readout: mean over the LAST 32 positions only (flatten at
+            # S=8k would be a 1M-wide dense); retrieval still spans the
+            # whole context because the marker lands anywhere in [0, S-64)
+            pooled = jnp.mean(z[:, -32:, :], axis=1)
+            logits, hs_new = head.apply(params["h"], state["h"], pooled,
+                                        training=training, rng=rng)
+            return logits, {"t": ts_new, "h": hs_new}
+
+    joint = Joint()
+    ts = create_train_state(joint, opt, key)
+    # jit=False: the data generation is fused into the outer jit below, and
+    # the outer jit must own the donation (an inner donate_argnums would be
+    # silently dropped — double-buffering the TrainState in the memory-
+    # marginal S=8192 regime)
+    base = make_train_step(joint, softmax_cross_entropy, opt, jit=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(ts, data_key, step_key, lr):
+        x, y = make_device_batch(data_key, B, S, E, nc)
+        return base(ts, x, y, step_key, lr)
+
+    @jax.jit
+    def eval_acc(params, state, data_key):
+        x, y = make_device_batch(data_key, B, S, E, nc)
+        logits, _ = joint.apply(params, state, x)
+        return jnp.mean(jnp.argmax(logits, -1) == jnp.argmax(y, -1))
+
+    t0 = time.perf_counter()
+    ts, loss, _ = step(ts, jax.random.fold_in(key, 98),
+                       jax.random.fold_in(key, 99), cfg.learning_rate)
+    jax.block_until_ready(loss)
+    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
+          f"(S={S} B={B} E={E} H={H})")
+
+    from dcnn_tpu.core.fence import hard_fence
+    for epoch in range(1, epochs + 1):
+        t0 = time.perf_counter()
+        losses = []
+        for i in range(steps):
+            k = jax.random.fold_in(key, epoch * 10000 + i)
+            ts, loss, _ = step(ts, jax.random.fold_in(k, 0),
+                               jax.random.fold_in(k, 1), cfg.learning_rate)
+            losses.append(loss)
+        hard_fence(losses[-1])
+        dt = time.perf_counter() - t0
+        acc = float(eval_acc(ts.params, ts.state,
+                             jax.random.fold_in(key, 555 + epoch)))
+        tok_s = B * S * steps / dt
+        print(f"epoch {epoch}: loss {float(jnp.mean(jnp.asarray(losses))):.4f} "
+              f"acc {acc:.3f} (fresh data) | {dt/steps*1e3:.1f} ms/step = "
+              f"{tok_s/1e6:.2f}M tokens/s")
+
+
+if __name__ == "__main__":
+    main()
