@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import print_table
 
 SECTIONS = ("bench_gemm", "bench_conv", "bench_ops", "bench_attention",
-            "bench_serialization", "bench_pipeline")
+            "bench_serialization", "bench_pipeline", "bench_pallas_conv")
 
 
 def main() -> int:
@@ -33,6 +33,9 @@ def main() -> int:
         os.path.dirname(os.path.abspath(__file__)), "results.json"))
     ap.add_argument("--only", nargs="*", default=None,
                     help="subset of section module names")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge the sections that ran into an existing --out "
+                         "report instead of replacing it (for --only reruns)")
     args = ap.parse_args()
 
     import importlib
@@ -60,6 +63,16 @@ def main() -> int:
         "all_correct": ok,
         "sections": docs,
     }
+    if args.merge and os.path.exists(args.out):
+        # refresh only the sections that ran (--only reruns), keep the rest,
+        # and recompute the top-level gate — no hand-splicing of the report
+        with open(args.out) as f:
+            prev = json.load(f)
+        merged = {s["section"]: s for s in prev.get("sections", [])}
+        merged.update({s["section"]: s for s in docs})
+        out["sections"] = list(merged.values())
+        out["all_correct"] = ok = bool(
+            all(s["all_correct"] for s in out["sections"]))
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(f"\nwrote {args.out}  all_correct={ok}")
