@@ -29,7 +29,8 @@ class RegressionDataLoader(BaseDataLoader):
                  targets: Optional[np.ndarray] = None,
                  csv_path: Optional[str] = None, num_targets: int = 1,
                  normalize_features: bool = False,
-                 normalize_targets: bool = True, skip_header: bool = True,
+                 normalize_targets: bool = True,
+                 skip_header: Optional[bool] = None,
                  **kw):
         kw.setdefault("drop_last", False)
         super().__init__(**kw)
@@ -41,6 +42,7 @@ class RegressionDataLoader(BaseDataLoader):
         self._features_in = features
         self._targets_in = targets
         self.csv_path = csv_path
+        self.skip_header = skip_header  # None = auto-sniff the first row
         self.num_targets = int(num_targets)
         self.normalize_features = bool(normalize_features)
         self.normalize_targets = bool(normalize_targets)
@@ -79,8 +81,10 @@ class RegressionDataLoader(BaseDataLoader):
     def _load_csv(self):
         if not os.path.isfile(self.csv_path):
             raise FileNotFoundError(self.csv_path)
+        skip = (self._csv_has_header() if self.skip_header is None
+                else self.skip_header)
         data = np.genfromtxt(self.csv_path, delimiter=",",
-                             skip_header=1 if self._csv_has_header() else 0,
+                             skip_header=1 if skip else 0,
                              dtype=np.float32)
         if data.ndim == 1:
             data = data[None, :]
