@@ -418,6 +418,119 @@ def test_residual_block_hand_computed():
     np.testing.assert_allclose(np.asarray(y), want, atol=1e-6)
 
 
+# ---- torch-generated golden fixtures (VERDICT r4 #1: independent oracle
+#      for conv/BN/pool/dense fwd AND bwd, beyond the hand-computed cases).
+#      Regenerate with: python torch_baselines/make_golden_fixtures.py ----
+
+_GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "torch_golden.npz")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    assert os.path.isfile(_GOLDEN), (
+        "committed fixture missing; regenerate with "
+        "python torch_baselines/make_golden_fixtures.py")
+    return np.load(_GOLDEN)
+
+
+def _vjp_against(layer, params, state, g, prefix, training=False):
+    """Forward + VJP of ``sum(y * dy)`` — the same cotangent the torch side
+    used — returning (y, dx, param_grads)."""
+    x = jnp.asarray(g[f"{prefix}.x"])
+    dy = jnp.asarray(g[f"{prefix}.dy"])
+
+    def fwd(p, xx):
+        y, _ = layer.apply(p, state, xx, training=training)
+        return y
+    y, vjp = jax.vjp(fwd, params, x)
+    dparams, dx = vjp(dy)
+    return y, dx, dparams
+
+
+def test_conv2d_matches_torch_golden(golden):
+    layer = Conv2DLayer(8, 5, stride=2, padding=1, use_bias=True,
+                        in_channels=3)
+    params, state = layer.init(KEY, (3, 12, 12))
+    params = dict(params, w=jnp.asarray(golden["conv.w"]),
+                  b=jnp.asarray(golden["conv.b"]))
+    y, dx, dp = _vjp_against(layer, params, state, golden, "conv")
+    np.testing.assert_allclose(np.asarray(y), golden["conv.y"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), golden["conv.dx"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["w"]), golden["conv.dw"],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dp["b"]), golden["conv.db"],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_matches_torch_golden(golden):
+    layer = BatchNormLayer(num_features=6, epsilon=1e-5, momentum=0.1)
+    params, state = layer.init(KEY, (6, 5, 5))
+    params = dict(params, gamma=jnp.asarray(golden["bn.gamma"]),
+                  beta=jnp.asarray(golden["bn.beta"]))
+    state = dict(state,
+                 running_mean=jnp.asarray(golden["bn.running_mean0"]),
+                 running_var=jnp.asarray(golden["bn.running_var0"]))
+    y, dx, dp = _vjp_against(layer, params, state, golden, "bn",
+                             training=True)
+    np.testing.assert_allclose(np.asarray(y), golden["bn.y"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), golden["bn.dx"],
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dp["gamma"]), golden["bn.dgamma"],
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dp["beta"]), golden["bn.dbeta"],
+                               rtol=1e-3, atol=1e-4)
+    # running-stat update rule matches torch (momentum semantics + unbiased
+    # batch variance into the running buffer)
+    _, new_state = layer.apply(params, state, jnp.asarray(golden["bn.x"]),
+                               training=True)
+    np.testing.assert_allclose(np.asarray(new_state["running_mean"]),
+                               golden["bn.running_mean1"], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["running_var"]),
+                               golden["bn.running_var1"], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_maxpool_matches_torch_golden(golden):
+    layer = MaxPool2DLayer(3, 2, 0)
+    params, state = layer.init(KEY, (4, 9, 9))
+    y, dx, _ = _vjp_against(layer, params, state, golden, "maxpool")
+    np.testing.assert_allclose(np.asarray(y), golden["maxpool.y"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), golden["maxpool.dx"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_avgpool_matches_torch_golden(golden):
+    layer = AvgPool2DLayer(2, 2, 1)
+    params, state = layer.init(KEY, (4, 6, 6))
+    y, dx, _ = _vjp_against(layer, params, state, golden, "avgpool")
+    np.testing.assert_allclose(np.asarray(y), golden["avgpool.y"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), golden["avgpool.dx"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dense_matches_torch_golden(golden):
+    layer = DenseLayer(5, use_bias=True, in_features=7)
+    params, state = layer.init(KEY, (7,))
+    params = dict(params, w=jnp.asarray(golden["dense.w"]),
+                  b=jnp.asarray(golden["dense.b"]))
+    y, dx, dp = _vjp_against(layer, params, state, golden, "dense")
+    np.testing.assert_allclose(np.asarray(y), golden["dense.y"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), golden["dense.dx"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["w"]), golden["dense.dw"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["b"]), golden["dense.db"],
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_residual_block_projection_shortcut_hand_computed():
     """Projection shortcut: out = relu(conv_main(x) + conv_short(x)) with
     1x1 convs x3 and x(-1): relu(3x - x) = relu(2x)."""

@@ -1,0 +1,100 @@
+"""Generate torch-computed golden fixtures for per-layer fwd/bwd parity.
+
+VERDICT r4 directive 1: the layer-value tests were hand-computed only; this
+script adds an INDEPENDENT oracle. For each layer type (conv / batchnorm /
+maxpool / avgpool / dense) it runs a small fixed-seed case through PyTorch,
+records input, params, output, and the backward grads (dx and param grads
+under a fixed upstream cotangent), and writes everything to
+``tests/fixtures/torch_golden.npz``. ``tests/test_layer_values.py`` replays
+the same cases through dcnn_tpu layers and compares.
+
+The fixture file is committed, so the tests run everywhere; re-run this
+script only to regenerate (requires torch):
+
+    python torch_baselines/make_golden_fixtures.py
+
+Reference analog: the gtest fixtures in
+``unit_tests/conv2d_layer_test.cpp`` compare against precomputed values; here
+the precomputation is torch instead of by hand.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import torch
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "torch_golden.npz")
+
+torch.manual_seed(0)
+g = {}
+
+
+def _rand(*shape):
+    return torch.randn(*shape, dtype=torch.float32)
+
+
+def _record(prefix, **arrs):
+    for k, v in arrs.items():
+        g[f"{prefix}.{k}"] = (v.detach().numpy() if torch.is_tensor(v)
+                              else np.asarray(v))
+
+
+# ---- conv2d: 2 samples, 3->8 ch, 5x5 kernel, stride 2, pad 1, bias ----
+x = _rand(2, 3, 12, 12).requires_grad_(True)
+conv = torch.nn.Conv2d(3, 8, 5, stride=2, padding=1, bias=True)
+y = conv(x)
+dy = _rand(*y.shape)
+y.backward(dy)
+_record("conv", x=x, w=conv.weight, b=conv.bias, dy=dy, y=y,
+        dx=x.grad, dw=conv.weight.grad, db=conv.bias.grad)
+
+# ---- batchnorm (training): 4 samples, 6 ch, 5x5; nonzero running stats ----
+x = _rand(4, 6, 5, 5).requires_grad_(True)
+bn = torch.nn.BatchNorm2d(6, eps=1e-5, momentum=0.1)
+with torch.no_grad():
+    bn.weight.copy_(_rand(6) * 0.5 + 1.0)
+    bn.bias.copy_(_rand(6) * 0.1)
+    bn.running_mean.copy_(_rand(6) * 0.2)
+    bn.running_var.copy_(torch.rand(6) + 0.5)
+rm0, rv0 = bn.running_mean.clone(), bn.running_var.clone()
+bn.train()
+y = bn(x)
+dy = _rand(*y.shape)
+y.backward(dy)
+_record("bn", x=x, gamma=bn.weight, beta=bn.bias,
+        running_mean0=rm0, running_var0=rv0, dy=dy, y=y,
+        dx=x.grad, dgamma=bn.weight.grad, dbeta=bn.bias.grad,
+        running_mean1=bn.running_mean, running_var1=bn.running_var)
+
+# ---- maxpool: 3x3 kernel stride 2 (overlapping windows) ----
+x = _rand(2, 4, 9, 9).requires_grad_(True)
+y = torch.nn.functional.max_pool2d(x, 3, stride=2)
+dy = _rand(*y.shape)
+y.backward(dy)
+_record("maxpool", x=x, dy=dy, y=y, dx=x.grad)
+
+# ---- avgpool: 2x2 stride 2 pad 1, count_include_pad=True (the reference
+#      semantics dcnn_tpu implements, avgpool2d_layer.tpp) ----
+x = _rand(2, 4, 6, 6).requires_grad_(True)
+y = torch.nn.functional.avg_pool2d(x, 2, stride=2, padding=1,
+                                   count_include_pad=True)
+dy = _rand(*y.shape)
+y.backward(dy)
+_record("avgpool", x=x, dy=dy, y=y, dx=x.grad)
+
+# ---- dense: 3 samples, 7 -> 5 features ----
+x = _rand(3, 7).requires_grad_(True)
+fc = torch.nn.Linear(7, 5, bias=True)
+y = fc(x)
+dy = _rand(*y.shape)
+y.backward(dy)
+_record("dense", x=x, w=fc.weight, b=fc.bias, dy=dy, y=y,
+        dx=x.grad, dw=fc.weight.grad, db=fc.bias.grad)
+
+os.makedirs(os.path.dirname(OUT), exist_ok=True)
+np.savez_compressed(OUT, **g)
+print(f"wrote {OUT}: {len(g)} arrays, "
+      f"{os.path.getsize(OUT) / 1024:.1f} KiB")
