@@ -1,0 +1,105 @@
+"""Dataset visual sanity check (reference
+``examples/tiny_imagenet_visual_check.cpp``): dump a few decoded samples
+from a loader to image files + print their labels, so a human can confirm
+the decode/augment pipeline isn't silently shearing images or scrambling
+labels.
+
+Writes dependency-free binary PPM (P6) files — viewable by any image tool —
+plus a coarse ASCII preview to stdout for terminal-only hosts.
+
+Usage:
+    python examples/dataset_visual_check.py [dataset] [outdir] [n]
+
+dataset: digits28 (default, bundled) | mnist | cifar10 | tiny_imagenet
+(the latter three require the dataset under data/ — same paths as
+examples/accuracy_gates.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from common import setup
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_ppm(path: str, img: np.ndarray) -> None:
+    """img: (H, W, C) float [0, 1] or uint8; C in {1, 3}."""
+    if img.dtype != np.uint8:
+        img = np.clip(img * 255.0, 0, 255).astype(np.uint8)
+    if img.shape[-1] == 1:
+        img = np.repeat(img, 3, axis=-1)
+    h, w, _ = img.shape
+    with open(path, "wb") as f:
+        f.write(f"P6\n{w} {h}\n255\n".encode())
+        f.write(img.tobytes())
+
+
+def _ascii(img: np.ndarray, width: int = 32) -> str:
+    """Coarse ASCII preview of a (H, W, C) [0,1] image."""
+    g = img.mean(axis=-1)
+    step = max(1, g.shape[1] // width)
+    g = g[::step * 2, ::step]   # terminal cells are ~2x taller than wide
+    ramp = " .:-=+*#%@"
+    idx = np.clip((g * (len(ramp) - 1)).astype(int), 0, len(ramp) - 1)
+    return "\n".join("".join(ramp[i] for i in row) for row in idx)
+
+
+def _load(name: str):
+    """Returns (loader, class_names or None). Loader batches are NHWC."""
+    if name == "digits28":
+        import accuracy_gates
+
+        from dcnn_tpu.data import MNISTDataLoader
+        csv = os.path.join(accuracy_gates.ensure_digits28_csvs(),
+                           "train.csv")
+        ld = MNISTDataLoader(csv, data_format="NHWC", batch_size=16,
+                             shuffle=False)
+    elif name == "mnist":
+        from dcnn_tpu.data import MNISTDataLoader
+        ld = MNISTDataLoader(os.path.join(ROOT, "data/mnist/train.csv"),
+                             data_format="NHWC", batch_size=16, shuffle=False)
+    elif name == "cifar10":
+        from dcnn_tpu.data import CIFAR10DataLoader
+        d = os.path.join(ROOT, "data/cifar-10-batches-bin")
+        ld = CIFAR10DataLoader([os.path.join(d, "data_batch_1.bin")],
+                               data_format="NHWC", batch_size=16,
+                               shuffle=False)
+    elif name == "tiny_imagenet":
+        from dcnn_tpu.data import TinyImageNetDataLoader
+        ld = TinyImageNetDataLoader(
+            os.path.join(ROOT, "data/tiny-imagenet-200"), split="train",
+            data_format="NHWC", batch_size=16, shuffle=False)
+    else:
+        raise SystemExit(f"unknown dataset {name}")
+    ld.load_data()
+    return ld
+
+
+def main():
+    setup("dataset_visual_check")
+    name = sys.argv[1] if len(sys.argv) > 1 else "digits28"
+    outdir = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        "/tmp", f"visual_check_{name}")
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    ld = _load(name)
+    os.makedirs(outdir, exist_ok=True)
+    x, y = next(iter(ld))
+    x = np.asarray(x)
+    y = np.asarray(y)
+    labels = y.argmax(-1) if y.ndim == 2 else y
+    for i in range(min(n, len(x))):
+        path = os.path.join(outdir, f"{name}_{i}_label{int(labels[i])}.ppm")
+        _write_ppm(path, x[i])
+        print(f"--- sample {i}: label {int(labels[i])} -> {path}")
+        print(_ascii(x[i]))
+    print(f"wrote {min(n, len(x))} PPM files to {outdir}; "
+          f"pixel range [{x.min():.3f}, {x.max():.3f}]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Eval-only inference driver: load a saved snapshot and evaluate it
+standalone (reference ``examples/mnist_cnn_test.cpp`` — the deployment-shaped
+half of checkpointing).
+
+Loads a best-val checkpoint, folds BatchNorm into the preceding linear layers
+(``dcnn_tpu.nn.fold_batchnorm`` — the inference graph a deployment would
+ship), evaluates top-1 on the bundled digits28 real-image test split, and
+prints throughput. The folded and unfolded models are both evaluated so the
+fold's correctness is proven end-to-end on real data, not just in unit tests.
+
+Usage:
+    python examples/evaluate_snapshot.py [snapshot_dir] [test_csv]
+
+Defaults: ``model_snapshots/mnist_cnn_model`` (committed — a digits28
+best-val checkpoint from the parity run) and ``data/digits28/test.csv``
+(regenerated deterministically if absent).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from common import setup
+
+import numpy as np
+
+import dcnn_tpu  # noqa: F401  (platform override side effects)
+import jax
+
+from dcnn_tpu.data import MNISTDataLoader
+from dcnn_tpu.nn import fold_batchnorm
+from dcnn_tpu.ops.losses import softmax_cross_entropy
+from dcnn_tpu.train import load_checkpoint
+from dcnn_tpu.train.trainer import evaluate_classification
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    setup("evaluate_snapshot")
+    snap = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        ROOT, "model_snapshots", "mnist_cnn_model")
+    if len(sys.argv) > 2:
+        csv = sys.argv[2]
+    else:
+        import accuracy_gates
+        csv = os.path.join(accuracy_gates.ensure_digits28_csvs(), "test.csv")
+
+    model, params, state, _, _, meta = load_checkpoint(snap)
+    print(f"loaded {snap}: model {model.name}, "
+          f"{sum(np.asarray(p).size for p in jax.tree_util.tree_leaves(params))}"
+          f" params, metadata {meta}")
+
+    # Sequential carries no format flag; the per-sample input_shape does —
+    # channels lead in NCHW ((1,28,28)) and trail in NHWC ((28,28,1))
+    fmt = "NCHW" if model.input_shape[0] <= model.input_shape[-1] else "NHWC"
+    val = MNISTDataLoader(csv, data_format=fmt, batch_size=256,
+                          shuffle=False, drop_last=False)
+    val.load_data()
+
+    loss, acc = evaluate_classification(model, params, state,
+                                        softmax_cross_entropy, val)
+    print(f"unfolded: top-1 {acc:.4f} loss {loss:.4f} "
+          f"({val.num_samples} samples)")
+
+    fmodel, fparams, fstate = fold_batchnorm(model, params, state)
+    floss, facc = evaluate_classification(fmodel, fparams, fstate,
+                                          softmax_cross_entropy, val)
+    print(f"BN-folded: top-1 {facc:.4f} loss {floss:.4f} "
+          f"({len(fmodel.layers)} layers, was {len(model.layers)})")
+    if abs(float(facc) - float(acc)) > 1e-3:
+        raise SystemExit(f"fold changed accuracy: {acc} -> {facc}")
+
+    # throughput on the folded inference graph (steady-state: time the
+    # second full pass, after compiles)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        evaluate_classification(fmodel, fparams, fstate,
+                                softmax_cross_entropy, val)
+        dt = time.perf_counter() - t0
+    print(f"inference throughput (BN-folded): "
+          f"{val.num_samples / dt:,.0f} img/s on "
+          f"{jax.devices()[0].device_kind}")
+
+
+if __name__ == "__main__":
+    main()
