@@ -44,10 +44,26 @@ def run() -> dict:
         "activations": rng.standard_normal(mb * 1024 * 256).astype(np.float32),
         "sparse_grads": (rng.standard_normal(mb * 1024 * 256) *
                          (rng.random(mb * 1024 * 256) < 0.05)).astype(np.float32),
+        # trained-weight-shaped payload: small-magnitude values cast to bf16
+        # (viewed as u16 so the npz framing stays vanilla numpy) — the
+        # dominant checkpoint/pipeline wire-dtype class
+        "weights_bf16": (
+            (rng.standard_normal(mb * 1024 * 512) * 0.05).astype(np.float32)
+            .view(np.uint32) >> np.uint32(16)).astype(np.uint16),
     }
     codecs = {"raw": RawCompressor(), "zlib1": ZlibCompressor(level=1)}
     if 2 in mc.codecs:
         codecs["zstd"] = mc.codecs[2]
+    try:
+        from dcnn_tpu.utils.compression import (Lz4Compressor,
+                                                ShuffleZstdCompressor)
+        codecs["lz4"] = Lz4Compressor()
+        # level 9: the reference Lz4hc default
+        # (internal_compressor.hpp:10-15); same codec id / block format
+        codecs["lz4hc9"] = Lz4Compressor(level=9)
+        codecs["shuffle_zstd"] = ShuffleZstdCompressor()
+    except RuntimeError:
+        pass  # no native toolchain — numpy-only host
 
     for pname, arr in payloads.items():
         nbytes = arr.nbytes

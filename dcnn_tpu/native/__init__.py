@@ -100,6 +100,11 @@ def lib() -> Optional[ctypes.CDLL]:
             getattr(l, fn).restype = ctypes.c_int64
         l.dcnn_lz4_compress_bound.argtypes = [ctypes.c_int64]
         l.dcnn_lz4_compress_bound.restype = ctypes.c_int64
+    if hasattr(l, "dcnn_lz4_compress_hc"):
+        l.dcnn_lz4_compress_hc.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int32]
+        l.dcnn_lz4_compress_hc.restype = ctypes.c_int64
     if hasattr(l, "dcnn_byte_shuffle"):
         for fn in ("dcnn_byte_shuffle", "dcnn_byte_unshuffle"):
             getattr(l, fn).argtypes = [
@@ -135,14 +140,28 @@ def lz4_available() -> bool:
     return l is not None and hasattr(l, "dcnn_lz4_compress")
 
 
-def lz4_compress(data: bytes) -> Optional[bytes]:
-    """LZ4 block-format compress (native). None if the lib is unavailable."""
+def lz4_compress(data: bytes, level: int = 0) -> Optional[bytes]:
+    """LZ4 block-format compress (native). ``level`` 0 = greedy single-probe
+    matcher; >= 1 = HC hash-chain search (deeper with higher levels, same
+    block format — the decoder cannot tell them apart). None if the lib is
+    unavailable."""
     l = lib()
     if l is None or not hasattr(l, "dcnn_lz4_compress"):
         return None
     src = np.frombuffer(data, np.uint8)
     dst = np.empty(int(l.dcnn_lz4_compress_bound(len(data))), np.uint8)
-    n = l.dcnn_lz4_compress(_u8ptr(src), src.size, _u8ptr(dst), dst.size)
+    if level > 0:
+        if not hasattr(l, "dcnn_lz4_compress_hc"):
+            # never silently downgrade a requested HC level to greedy (a
+            # prebuilt .so deployed without src/ can lack the symbol)
+            raise RuntimeError(
+                "lz4 HC level requested but libdcnn_native.so predates the "
+                "HC encoder — rebuild it (delete the .so next to "
+                "dcnn_tpu/native and re-import with src/ present)")
+        n = l.dcnn_lz4_compress_hc(_u8ptr(src), src.size, _u8ptr(dst),
+                                   dst.size, level)
+    else:
+        n = l.dcnn_lz4_compress(_u8ptr(src), src.size, _u8ptr(dst), dst.size)
     if n < 0:
         raise ValueError("lz4 compress: destination bound overflow")
     return dst[:n].tobytes()

@@ -93,14 +93,24 @@ class Lz4Compressor:
 
     codec_id = 3
 
-    def __init__(self):
+    def __init__(self, level: int = 0):
+        """``level`` 0 = greedy matcher (fastest); 1-13 = HC hash-chain
+        search with one-byte lazy evaluation (the reference's Lz4hc level
+        semantics: deeper search, better ratio, same block format — the
+        codec id and decode path are identical)."""
         from .. import native as _native
         if not _native.lz4_available():
             raise RuntimeError("native lz4 codec unavailable (no toolchain)")
         self._n = _native
+        self.level = int(level)
+        if self.level > 0:
+            # probe now so a prebuilt .so lacking the HC symbol fails at
+            # construction (where callers guard with except RuntimeError),
+            # not mid-payload
+            _native.lz4_compress(b"", level=self.level)
 
     def compress(self, data: bytes) -> bytes:
-        return self._n.lz4_compress(data)
+        return self._n.lz4_compress(data, level=self.level)
 
     def decompress(self, data: bytes, raw_size: int) -> bytes:
         return self._n.lz4_decompress(data, raw_size)
@@ -179,8 +189,10 @@ class MetaCompressor:
             if codec_id in lazy:
                 try:
                     self.register(lazy[codec_id]())
-                except RuntimeError:
-                    pass
+                except RuntimeError as err:
+                    raise ValueError(
+                        f"codec id {codec_id} known but unavailable on this "
+                        f"host: {err}") from err
         if codec_id not in self.codecs:
             raise ValueError(f"unknown codec id {codec_id}")
         return self.codecs[codec_id].decompress(blob[self._HEADER.size:], raw_size)

@@ -134,6 +134,37 @@ def test_lz4_roundtrip_payload_classes(rng):
 
 
 @requires_native
+def test_lz4_hc_roundtrip_and_ratio(rng):
+    """HC level (hash-chain + lazy match, reference Lz4hc slot
+    internal_compressor.hpp:10-15): same block format — the plain decoder
+    reads it — and a ratio at least as good as greedy everywhere, strictly
+    better on structured sparse payloads."""
+    payloads = [
+        b"", b"x", b"abc", b"a" * 100_000,
+        bytes(rng.integers(0, 256, 70_000, dtype=np.uint8)),
+        np.arange(4096, dtype=np.float32).tobytes(),
+        (b"the quick brown fox " * 5000),
+    ]
+    for lvl in (1, 9, 13):
+        for p in payloads:
+            c = native.lz4_compress(p, level=lvl)
+            assert native.lz4_decompress(c, len(p)) == p
+    # sparse-gradient-shaped payload: chained search must beat greedy
+    n = 65536
+    sg = (rng.standard_normal(n) * (rng.random(n) < 0.05)).astype(np.float32)
+    data = sg.tobytes()
+    greedy = native.lz4_compress(data)
+    hc = native.lz4_compress(data, level=9)
+    assert native.lz4_decompress(hc, len(data)) == data
+    assert len(hc) < len(greedy) * 0.75, (len(hc), len(greedy))
+    # ratio never worse than greedy on any payload class
+    for p in payloads:
+        if p:
+            assert len(native.lz4_compress(p, level=9)) <= \
+                len(native.lz4_compress(p)) + 8
+
+
+@requires_native
 def test_lz4_decompress_spec_vector():
     """Hand-encoded stream per the public LZ4 block spec: token 0x17 =
     1 literal + (7+4)-byte match at offset 1 → 12 × 'a'."""
