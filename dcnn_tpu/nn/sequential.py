@@ -88,8 +88,14 @@ class Sequential:
         new_state = []
         for i, layer in enumerate(self.layers):
             sub_rng = jax.random.fold_in(rng, i) if rng is not None else None
-            h, s = layer.apply(cast_to_compute(params[i]), state[i], h,
-                               training=training, rng=sub_rng)
+            # named_scope tags every op with its layer in profiler traces, so
+            # xprof framework-op stats aggregate per layer (the fused-step
+            # ground truth the replay profiler is compared against in
+            # RESULTS.md "profiling skew"); zero runtime cost outside tracing
+            with jax.named_scope(getattr(layer, "name", None)
+                                 or f"layer{i}"):
+                h, s = layer.apply(cast_to_compute(params[i]), state[i], h,
+                                   training=training, rng=sub_rng)
             new_state.append(s)
         return h, tuple(new_state)
 

@@ -484,6 +484,22 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_kv, scale,
     return unflat(dq, sq_p, sq), unflat(dk, sk_p, sk), unflat(dv, sk_p, sk)
 
 
+def _flash_geometry_safe(b: int, h: int, sq: int, sk: int, d: int) -> bool:
+    """Can the Pallas backward kernels run this geometry without VMEM
+    overflow? Mosaic lane-pads the trailing head dim to 128; for d >= 32 the
+    blocked pipeline streams tiles and any length fits, but at very small
+    head dims (measured: d=16, S=8192, b·h=16 on v5e) Mosaic falls back to a
+    layout that materialises whole lane-padded (b·h, S, 128) operands in
+    VMEM — "scoped allocation exceeded 16M" at compile time. Gate on the
+    padded whole-operand footprint with a safety margin so those shapes take
+    the numerically-equivalent blockwise path instead of failing to
+    compile."""
+    if d >= 32:
+        return True
+    padded_bytes = b * h * max(sq, sk) * 128 * 4
+    return padded_bytes <= 12 * 2**20
+
+
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention(q, k, v, causal, block_q, block_kv, scale, interpret):
@@ -549,6 +565,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return blockwise_attention(q, k, v, causal=causal,
                                    block_kv=block_kv, scale=scale)
     if interpret is None and jax.default_backend() != "tpu":
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_kv=block_kv, scale=scale)
+    b, h, sq, _ = q.shape
+    if not interpret and not _flash_geometry_safe(b, h, sq, k.shape[2],
+                                                  q.shape[-1]):
+        # tiny head dims at long S overflow VMEM in the Pallas backward
+        # (see _flash_geometry_safe) — auto-fallback, same math. The limit
+        # is a Mosaic TPU-lowering property, so an explicit interpret=True
+        # (kernel debugging) bypasses the gate.
         return blockwise_attention(q, k, v, causal=causal,
                                    block_kv=block_kv, scale=scale)
     return _flash_attention(q, k, v, causal, block_q, block_kv, float(scale),
