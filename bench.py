@@ -104,14 +104,14 @@ def _measure(step, ts, x, y, key, steps, reps):
 
     from dcnn_tpu.core.fence import hard_fence
 
-    best = float("inf")
+    rep_times = []
     for r in range(reps):
         t0 = time.perf_counter()
         for i in range(steps):
             ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
         hard_fence(loss)
-        best = min(best, time.perf_counter() - t0)
-    return best, ts
+        rep_times.append(time.perf_counter() - t0)
+    return min(rep_times), ts, rep_times
 
 
 def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
@@ -156,18 +156,30 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
         step = make_train_step(model, softmax_cross_entropy, opt)
         dispatches = steps
 
-    # warmup / compile (a few steps: first-call autotuning + tunnel spin-up)
+    # warmup / compile (a few steps: first-call autotuning + tunnel spin-up).
+    # Phase walls are recorded separately so the variance study (RESULTS.md)
+    # can attribute run-to-run spread: compile (first dispatch, cache-served
+    # or not), remaining warmup, then the timed reps.
     from dcnn_tpu.core.fence import hard_fence
-    for i in range(2 if chunk > 1 else 4):
+    t0 = time.perf_counter()
+    ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, 997), 1e-3)
+    hard_fence(loss)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(1, 2 if chunk > 1 else 4):
         ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, 997 + i), 1e-3)
     hard_fence(loss)
+    warmup_s = time.perf_counter() - t0
 
     if profile_dir:
         with jax.profiler.trace(profile_dir):
-            _, ts = _measure(step, ts, x, y, key, min(dispatches, 5), 1)
+            _, ts, _ = _measure(step, ts, x, y, key, min(dispatches, 5), 1)
 
-    dt, ts = _measure(step, ts, x, y, key, dispatches, reps)
+    dt, ts, rep_times = _measure(step, ts, x, y, key, dispatches, reps)
     img_per_sec = batch * steps / dt
+    phases = {"compile_s": round(compile_s, 3), "warmup_s": round(warmup_s, 3),
+              "rep_s": [round(r, 4) for r in rep_times],
+              "steps_per_rep": steps}
 
     resident_img_per_sec = None
     if pipeline and os.environ.get("BENCH_RESIDENT", "1") != "0":
@@ -274,9 +286,11 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
             pipeline_img_per_sec = batch * n / (time.perf_counter() - t0)
 
     streaming_img_per_sec = overlap_eff = None
-    # opt-in: the shard-step compile + tunnel staging adds minutes to the
-    # driver bench; the capability measurement is recorded in RESULTS.md
-    if pipeline and os.environ.get("BENCH_STREAMING", "0") == "1":
+    streaming_timeline = None
+    # default-on since r5 (VERDICT r4 #4: the driver capture must carry a
+    # real number); BENCH_STREAMING=0 opts out. The section is sized to stay
+    # ~15-30 s on the tunnelled host.
+    if pipeline and os.environ.get("BENCH_STREAMING", "1") == "1":
         # Streaming feed (data/streaming.py): datasets > HBM stream through
         # in double-buffered uint8 shards — shard i+1's async device_put
         # rides under shard i's fused dispatch. Law: epoch wall ≈
@@ -310,23 +324,37 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
         ts4, _ = train_streaming_epoch(sstep, ts4, sds,
                                        jax.random.fold_in(key, 8000), 1e-3)
         _hf(ts4.params)  # warmup epoch: compile + H2D path
+        tl = []
         t0 = time.perf_counter()
         ts4, _ = train_streaming_epoch(sstep, ts4, sds,
-                                       jax.random.fold_in(key, 8001), 1e-3)
+                                       jax.random.fold_in(key, 8001), 1e-3,
+                                       timeline=tl)
         _hf(ts4.params)
         wall = time.perf_counter() - t0
         streaming_img_per_sec = n_s / wall
         t_compute = n_s / img_per_sec
-        t_feed = (xs_host.nbytes / (h2d_gbps * 1e9)
-                  if h2d_gbps else 0.0)
+        # measured feed time from the per-shard timeline (the producer
+        # thread's actual gather + blocking device_put walls), not the bulk
+        # h2d_gbps estimate — the r4 overlap number was computed against the
+        # estimate and under-credited the implementation
+        t_feed = (sum(e["gather_s"] + e["put_s"] for e in tl)
+                  or (xs_host.nbytes / (h2d_gbps * 1e9) if h2d_gbps else 0.0))
         overlap_eff = max(t_feed, t_compute) / wall
+        streaming_timeline = {
+            "gather_s": round(sum(e["gather_s"] for e in tl), 3),
+            "put_s": round(sum(e["put_s"] for e in tl), 3),
+            "dispatch_s": round(sum(e["dispatch_s"] for e in tl), 3),
+            "queue_wait_s": round(sum(e["queue_wait_s"] for e in tl), 3),
+            "wall_s": round(wall, 3),
+            "t_compute_est_s": round(t_compute, 3)}
 
     # analytic training FLOPs: fwd + bwd ~= 3x forward (standard convention;
     # the reference's partitioner uses the same estimator family)
     fwd_flops_per_img = model.forward_complexity()
     train_flops = 3.0 * fwd_flops_per_img * img_per_sec
     return (img_per_sec, dt / steps, train_flops / 1e12, pipeline_img_per_sec,
-            h2d_gbps, resident_img_per_sec, streaming_img_per_sec, overlap_eff)
+            h2d_gbps, resident_img_per_sec, streaming_img_per_sec, overlap_eff,
+            phases, streaming_timeline)
 
 
 def main() -> None:
@@ -350,7 +378,8 @@ def main() -> None:
     chunk = int(os.environ.get("BENCH_CHUNK", "20"))
 
     (img_per_sec, sec_per_step, tflops, pipeline_ips, h2d_gbps,
-     resident_ips, streaming_ips, overlap_eff) = run_config(
+     resident_ips, streaming_ips, overlap_eff, phases,
+     streaming_timeline) = run_config(
         batch, steps, reps, data_format, profile_dir, chunk=chunk,
         pipeline=True)
 
@@ -404,6 +433,10 @@ def main() -> None:
                                   if streaming_ips is not None else None),
         "streaming_overlap_efficiency": (round(overlap_eff, 3)
                                          if overlap_eff is not None else None),
+        "streaming_timeline": streaming_timeline,
+        # per-phase walls of the headline measurement (variance accounting:
+        # RESULTS.md "variance budget" section)
+        "phases": phases,
     }
 
     if os.environ.get("BENCH_MATRIX"):

@@ -27,7 +27,10 @@ compute-bound for uint8 image payloads.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -116,27 +119,96 @@ class StreamingDeviceDataset:
 
 
 def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
-                          lr: float):
-    """One epoch with double-buffered staging: shard *i+1*'s ``device_put``
-    is issued (async) before shard *i*'s dispatch is awaited, so the H2D
-    transfer rides under the device compute. Returns (ts, mean_loss)."""
+                          lr: float, *,
+                          timeline: Optional[List[dict]] = None):
+    """One epoch with a producer thread feeding a bounded queue: the host
+    side of the feed (shard gather — a fancy-index copy that costs real time
+    on a 1-core host — plus the ``device_put`` issue, which on a tunnelled
+    TPU blocks for the full wire transfer) runs on its own thread, so it
+    overlaps the device compute the consumer loop dispatches. numpy fancy
+    indexing and the PjRt host-to-device path both release the GIL, so the
+    overlap is real even on one core.
+
+    The r4 single-thread version interleaved gather/put/dispatch in ONE
+    Python loop: every per-shard host cost (gather + blocking put) was
+    serial with the dispatch cadence, capping overlap_efficiency at 0.40 on
+    the bench host (RESULTS.md r4). Queue depth 1 bounds steady-state HBM at
+    ~3 shards (computing + queued + in-transfer).
+
+    ``timeline``: pass a list to receive one dict per shard —
+    ``{shard, gather_s, put_s, queue_wait_s, dispatch_s, put_done_t,
+    dispatch_t}`` (absolute times relative to epoch start) — the
+    measurement surface for the overlap accounting in RESULTS.md.
+
+    Returns (ts, mean_loss)."""
     dev = jax.devices()[0]
-    it = dataset.shards()
-    nxt = next(it, None)
-    staged = None
-    if nxt is not None:
-        staged = (jax.device_put(nxt[0], dev), jax.device_put(nxt[1], dev))
+    t_epoch0 = time.perf_counter()
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+    stop = threading.Event()
+
+    def put_or_stop(item) -> bool:
+        # never park unconditionally in q.put: the consumer may have died
+        # (step() raised) and set `stop` — re-check it every timeout tick so
+        # the thread always exits and its staged HBM buffers get released
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        # the terminating sentinel is (None | exception): a producer-side
+        # failure (device_put OOM, tunnel error, a raising shards()) must
+        # reach the consumer as a re-raised exception, never as a silent
+        # missing sentinel that would park q.get() forever
+        err = None
+        try:
+            it = dataset.shards()
+            i = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                nxt = next(it, None)
+                t1 = time.perf_counter()
+                if nxt is None:
+                    break
+                sx = jax.device_put(nxt[0], dev)
+                sy = jax.device_put(nxt[1], dev)
+                t2 = time.perf_counter()
+                if not put_or_stop(
+                        (i, sx, sy, t1 - t0, t2 - t1, t2 - t_epoch0)):
+                    return
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
+            err = e
+        put_or_stop(err)
+
+    worker = threading.Thread(target=producer, name="stream-feed",
+                              daemon=True)
+    worker.start()
     losses = []
-    i = 0
-    while staged is not None:
-        cur = staged
-        nxt = next(it, None)
-        # issue the NEXT transfer before dispatching compute: both are
-        # async, and the dispatch below overlaps the in-flight H2D
-        staged = None if nxt is None else (
-            jax.device_put(nxt[0], dev), jax.device_put(nxt[1], dev))
-        ts, loss = step(ts, cur[0], cur[1], jax.random.fold_in(rng, i), lr)
-        losses.append(loss)
-        i += 1
+    try:
+        while True:
+            t3 = time.perf_counter()
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            i, sx, sy, gather_s, put_s, put_done_t = item
+            t4 = time.perf_counter()
+            ts, loss = step(ts, sx, sy, jax.random.fold_in(rng, i), lr)
+            t5 = time.perf_counter()
+            losses.append(loss)
+            if timeline is not None:
+                timeline.append({
+                    "shard": i, "gather_s": gather_s, "put_s": put_s,
+                    "queue_wait_s": t4 - t3, "dispatch_s": t5 - t4,
+                    "put_done_t": put_done_t,
+                    "dispatch_t": t5 - t_epoch0})
+    finally:
+        stop.set()
+        worker.join(timeout=60.0)
     mean = float(np.mean([float(l) for l in losses])) if losses else 0.0
     return ts, mean
