@@ -1,0 +1,200 @@
+"""Replay-vs-reality profiling skew (VERDICT r4 #9).
+
+``LayerProfiler`` measures layers by re-running the chain eagerly with a
+device fence per layer (a *replay* — the only way to get per-layer walls
+when XLA fuses the real step). This study quantifies, once, how that replay's
+per-layer ranking compares against the *fused* train step's ground truth
+from an xprof trace:
+
+1. replay: ``profile_forward`` + ``profile_backward`` on ResNet-9 (one
+   batch) → per-layer fwd+bwd µs shares;
+2. fused: ``jax.profiler.trace`` around real train steps → parse the
+   ``.xplane.pb`` with xprof's ``framework_op_stats`` and aggregate op
+   self-time by the per-layer ``jax.named_scope`` tags Sequential.apply
+   emits;
+3. report both shares side by side + the Spearman rank correlation.
+
+Writes ``benchmarks/results_profiling_skew.json``; the table of record goes
+to RESULTS.md. Run on the TPU host: ``python benchmarks/profiling_skew.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "benchmarks", "results_profiling_skew.json")
+
+
+def replay_shares(model, params, state, x, y, key):
+    from dcnn_tpu.core.config import ProfilerType
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train.profiling import LayerProfiler
+
+    import jax
+    import jax.numpy as jnp
+
+    prof = LayerProfiler(ProfilerType.CUMULATIVE)
+    logits, _ = prof.profile_forward(model, params, state, x,
+                                     training=True, rng=key)
+    g = jax.grad(lambda o: softmax_cross_entropy(o, jnp.asarray(y)))(logits)
+    prof.profile_backward(model, params, state, x, g, rng=key)
+    total = {n: prof.forward_us.get(n, 0.0) + prof.backward_us.get(n, 0.0)
+             for n in set(prof.forward_us) | set(prof.backward_us)}
+    s = sum(total.values())
+    return {n: v / s for n, v in total.items()}
+
+
+def fused_shares(model, params, state, x, y, key, trace_dir):
+    """Trace N fused steps, aggregate HLO self-time by layer scope."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.core.fence import hard_fence
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.train import make_train_step
+    from dcnn_tpu.train.trainer import create_train_state
+
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, key)
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    for i in range(3):   # compile + warm
+        ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
+    hard_fence(loss)
+    with jax.profiler.trace(trace_dir):
+        for i in range(5):
+            ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, 10 + i), 1e-3)
+        hard_fence(loss)
+
+    planes = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True)
+    if not planes:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    from xprof.convert import raw_to_tool_data as rtd
+    data, _ = rtd.xspace_to_tool_data(planes, "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    rows = _op_rows(json.loads(data) if isinstance(data, str) else data)
+    layer_names = [l.name for l in model.layers]
+    agg = {n: 0.0 for n in layer_names}
+    other = 0.0
+    for name, t in rows:
+        hit = None
+        for ln in layer_names:
+            if re.search(rf"(^|/){re.escape(ln)}(/|$|\.)", name) or ln in name:
+                hit = ln
+                break
+        if hit:
+            agg[hit] += t
+        else:
+            other += t
+    s = sum(agg.values())
+    return ({n: v / s for n, v in agg.items()} if s else {}), \
+        other / max(s + other, 1e-9)
+
+
+def _op_rows(parsed):
+    """Extract (op_name_with_scope, self_time) pairs from the
+    framework_op_stats payload. The plugin ships gviz DataTables — possibly
+    a list of them (device table first) — with column ids/labels naming an
+    operation column and a self-time column; tolerate either shape."""
+    tables = parsed if isinstance(parsed, list) else [parsed]
+    out = []
+    for tab in tables:
+        if not isinstance(tab, dict) or "cols" not in tab:
+            continue
+        labels = [(c.get("label") or c.get("id") or "").lower()
+                  for c in tab["cols"]]
+
+        def find(*cands):
+            for cand in cands:
+                for i, lab in enumerate(labels):
+                    if cand in lab:
+                        return i
+            return None
+        c_name = find("operation", "op name", "op_name")
+        c_time = find("total self", "self time", "self_time", "self-time")
+        if c_name is None or c_time is None:
+            continue
+        for row in tab.get("rows", []):
+            cells = row.get("c", [])
+            if len(cells) <= max(c_name, c_time):
+                continue
+            name = cells[c_name].get("v")
+            t = cells[c_time].get("v")
+            if isinstance(name, str) and isinstance(t, (int, float)):
+                out.append((name, float(t)))
+        if out:
+            break  # device table only — host ops are not chip time
+    if not out:
+        raise SystemExit(
+            f"could not parse framework_op_stats payload: "
+            f"{str(parsed)[:400]}")
+    return out
+
+
+def spearman(a, b):
+    ra = np.argsort(np.argsort(a))
+    rb = np.argsort(np.argsort(b))
+    if len(a) < 2:
+        return 1.0
+    return float(np.corrcoef(ra, rb)[0, 1])
+
+
+def main():
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.models.zoo import create_resnet9_cifar10
+
+    fmt = "NHWC" if jax.default_backend() == "tpu" else "NCHW"
+    model = create_resnet9_cifar10(fmt)
+    key = jax.random.PRNGKey(0)
+    params, state = model.init(key)
+    rng = np.random.default_rng(0)
+    batch = int(os.environ.get("SKEW_BATCH", "128"))
+    shape = ((batch, 3, 32, 32) if fmt == "NCHW" else (batch, 32, 32, 3))
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, batch)])
+
+    rep = replay_shares(model, params, state, x, y, key)
+    with tempfile.TemporaryDirectory(prefix="skew_trace_") as td:
+        fus, unattributed = fused_shares(model, params, state, x, y, key, td)
+
+    names = [l.name for l in model.layers if l.name in rep]
+    rep_v = np.array([rep.get(n, 0.0) for n in names])
+    fus_v = np.array([fus.get(n, 0.0) for n in names])
+    rho = spearman(rep_v, fus_v)
+
+    print(f"{'layer':<16s} {'replay %':>9s} {'fused %':>9s}")
+    for n in sorted(names, key=lambda n: -rep.get(n, 0)):
+        print(f"{n:<16s} {100 * rep.get(n, 0):>8.1f}% "
+              f"{100 * fus.get(n, 0):>8.1f}%")
+    print(f"spearman rank correlation: {rho:.3f}; "
+          f"unattributed fused time: {100 * unattributed:.1f}%")
+
+    doc = {"section": "profiling_skew", "model": model.name, "batch": batch,
+           "format": fmt,
+           "device": jax.devices()[0].device_kind,
+           "replay_share": {n: round(rep.get(n, 0.0), 4) for n in names},
+           "fused_share": {n: round(fus.get(n, 0.0), 4) for n in names},
+           "spearman_rank_corr": round(rho, 4),
+           "fused_unattributed_frac": round(unattributed, 4)}
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
