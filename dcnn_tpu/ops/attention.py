@@ -413,9 +413,26 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, block_q, block_kv, scale,
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_kv = min(block_kv, sk)
+    # q-side padding MUST use the forward's block_q: the saved lse is
+    # already padded to that length (see _flash_forward). Tile shrinking
+    # below only halves, so any smaller tile still divides sq_p evenly.
     pad_q = -sq % block_q
     pad_kv = -sk % block_kv
     sq_p, sk_p = sq + pad_q, sk + pad_kv
+    # Scoped-VMEM guard (measured on v5e, 16M limit): the backward kernels
+    # hold ~5 (block_q × block_kv) fp32 intermediates; at the tuned
+    # 1024×512 tiles the largest geometries overflow marginally — observed
+    # "scoped allocation 16.70M > 16.00M" at b·h=64, S=8192, d=64, while
+    # b·h=16 at S=8192 and b·h=32 at S=4096 fit. Beyond that measured
+    # frontier, halve tiles (kv first) until the working set is safely
+    # under the limit; tuned-good configs keep their blocks.
+    if b * h * max(sq, sk) >= (1 << 19):
+        while block_q * block_kv > 1024 * 256 and block_kv > 128:
+            block_kv //= 2
+        while block_q * block_kv > 1024 * 256 and block_q > 128:
+            block_q //= 2
+        pad_kv = -sk % block_kv
+        sk_p = sk + pad_kv
 
     # Δ = rowsum(dO * O), fp32 (a cheap fused elementwise+reduce in XLA)
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)

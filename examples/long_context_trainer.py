@@ -13,9 +13,15 @@ No reference analog (the reference is CNN-only, SURVEY.md §5.7) — this is
 the framework's long-context capability as a runnable artifact.
 
 Env: SEQ_LEN (default 2048), EMBED (128), HEADS (2 — head_dim 64 is the
-lane-friendly TPU shape; head_dim 16 from HEADS=8 trips a marginal VMEM
-overflow in the flash backward at S=8192), BATCH (32), STEPS_PER_EPOCH
-(60), EPOCHS (8), NUM_CLASSES (16).
+lane-friendly TPU shape; smaller head dims at long S take the automatic
+blockwise fallback, see ops.attention._flash_geometry_safe), BATCH (32),
+STEPS_PER_EPOCH (60), EPOCHS (8), NUM_CLASSES (16), CURRICULUM
+("S:epochs", e.g. "2048:3" — progressive length extension: train the
+retrieval circuit at a short length first, then continue at SEQ_LEN with
+the same weights. The attention stack carries no positional parameters, so
+the content-based marker-retrieval circuit transfers across lengths;
+from-scratch training at S=8192 sits at chance because the gradient
+through the 1/8192-diluted softmax is too weak to bootstrap the circuit).
 
 Measured (v5e, bf16): defaults (S=2048, B=32) reach 100% fresh-data
 accuracy by epoch 5 at ~34-49 ms/step (1.34-1.95M tokens/s);
@@ -33,6 +39,7 @@ from common import setup
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dcnn_tpu.nn import SequentialBuilder
 from dcnn_tpu.nn.attention_layer import MultiHeadAttentionLayer
@@ -125,41 +132,62 @@ def main():
     # marginal S=8192 regime)
     base = make_train_step(joint, softmax_cross_entropy, opt, jit=False)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(ts, data_key, step_key, lr):
-        x, y = make_device_batch(data_key, B, S, E, nc)
-        return base(ts, x, y, step_key, lr)
+    def make_phase_fns(s_len: int):
+        """Per-length jits: the attention stack is shape-agnostic (no
+        positional params), so the SAME TrainState flows through every
+        phase — only the compiled executables are per-length."""
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(ts, data_key, step_key, lr):
+            x, y = make_device_batch(data_key, B, s_len, E, nc)
+            return base(ts, x, y, step_key, lr)
 
-    @jax.jit
-    def eval_acc(params, state, data_key):
-        x, y = make_device_batch(data_key, B, S, E, nc)
-        logits, _ = joint.apply(params, state, x)
-        return jnp.mean(jnp.argmax(logits, -1) == jnp.argmax(y, -1))
+        @jax.jit
+        def eval_acc(params, state, data_key):
+            x, y = make_device_batch(data_key, B, s_len, E, nc)
+            logits, _ = joint.apply(params, state, x)
+            return jnp.mean(jnp.argmax(logits, -1) == jnp.argmax(y, -1))
+        return step, eval_acc
 
-    t0 = time.perf_counter()
-    ts, loss, _ = step(ts, jax.random.fold_in(key, 98),
-                       jax.random.fold_in(key, 99), cfg.learning_rate)
-    jax.block_until_ready(loss)
-    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
-          f"(S={S} B={B} E={E} H={H})")
+    # progressive length extension: optional short-S phase(s) first
+    phases = []
+    for spec in filter(None, get_env("CURRICULUM", "").split(",")):
+        s_c, ep_c = spec.split(":")
+        phases.append((int(s_c), int(ep_c)))
+    phases.append((S, epochs))
 
     from dcnn_tpu.core.fence import hard_fence
-    for epoch in range(1, epochs + 1):
+    for phase_i, (s_len, n_epochs) in enumerate(phases):
+        # fold the phase INDEX (not just the length) into every key so a
+        # curriculum phase sharing SEQ_LEN's length never replays batches
+        pkey = jax.random.fold_in(key, phase_i)
+        step, eval_acc = make_phase_fns(s_len)
         t0 = time.perf_counter()
-        losses = []
-        for i in range(steps):
-            k = jax.random.fold_in(key, epoch * 10000 + i)
-            ts, loss, _ = step(ts, jax.random.fold_in(k, 0),
-                               jax.random.fold_in(k, 1), cfg.learning_rate)
-            losses.append(loss)
-        hard_fence(losses[-1])
-        dt = time.perf_counter() - t0
-        acc = float(eval_acc(ts.params, ts.state,
-                             jax.random.fold_in(key, 555 + epoch)))
-        tok_s = B * S * steps / dt
-        print(f"epoch {epoch}: loss {float(jnp.mean(jnp.asarray(losses))):.4f} "
-              f"acc {acc:.3f} (fresh data) | {dt/steps*1e3:.1f} ms/step = "
-              f"{tok_s/1e6:.2f}M tokens/s")
+        ts, loss, _ = step(ts, jax.random.fold_in(pkey, 98),
+                           jax.random.fold_in(pkey, 99), cfg.learning_rate)
+        jax.block_until_ready(loss)
+        print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
+              f"(S={s_len} B={B} E={E} H={H})")
+        for epoch in range(1, n_epochs + 1):
+            t0 = time.perf_counter()
+            losses = []
+            for i in range(steps):
+                k = jax.random.fold_in(pkey, epoch * 10000 + i)
+                ts, loss, _ = step(ts, jax.random.fold_in(k, 0),
+                                   jax.random.fold_in(k, 1),
+                                   cfg.learning_rate)
+                losses.append(loss)
+            hard_fence(losses[-1])
+            dt = time.perf_counter() - t0
+            # 4-batch fresh-data eval: tighter estimate than one batch
+            acc = float(np.mean([float(eval_acc(
+                ts.params, ts.state,
+                jax.random.fold_in(pkey, 555 + epoch * 7 + j)))
+                for j in range(4)]))
+            tok_s = B * s_len * steps / dt
+            print(f"[S={s_len}] epoch {epoch}: "
+                  f"loss {float(jnp.mean(jnp.asarray(losses))):.4f} "
+                  f"acc {acc:.3f} (fresh data) | {dt/steps*1e3:.1f} ms/step "
+                  f"= {tok_s/1e6:.2f}M tokens/s")
 
 
 if __name__ == "__main__":
