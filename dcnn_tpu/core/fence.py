@@ -23,19 +23,59 @@ the TPU-tunnel-safe analog.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import jax.tree_util as jtu
+
+
+@jax.jit
+def _probe(leaves):
+    """One scalar depending on one element of EVERY leaf: reading it back
+    fences all of them with a single device->host round trip."""
+    return sum((l.ravel()[0].astype(jnp.float32) for l in leaves),
+               jnp.float32(0.0))
 
 
 def hard_fence(tree) -> None:
     """Block until every array leaf in ``tree`` has finished computing.
 
-    Implemented as a device->host transfer of one element per leaf, which —
-    unlike ``block_until_ready`` on proxied backends — is a true fence: the
-    bytes cannot be produced before the producing computation completes.
+    Implemented as a device->host transfer, which — unlike
+    ``block_until_ready`` on proxied backends — is a true fence: the bytes
+    cannot be produced before the producing computation completes.
+
+    Multi-leaf trees are fenced through ONE jitted scalar that consumes an
+    element of every leaf, then ONE readback. The per-leaf device_get loop
+    this replaces cost a full tunnel round trip per leaf (~94 ms each on
+    the axon backend — 7.9 s to fence a ResNet-18 param tree, which
+    silently dominated any wall-clock it was part of). The probe executable
+    is cached per tree structure/shapes, so steady-state cost is one
+    dispatch + one RTT regardless of leaf count.
     """
-    for leaf in jtu.tree_leaves(tree):
-        if hasattr(leaf, "shape"):
-            if getattr(leaf, "size", 1) == 0:
-                continue
-            first = leaf if leaf.ndim == 0 else leaf.ravel()[0]
-            jax.device_get(first)
+    leaves = [l for l in jtu.tree_leaves(tree)
+              if hasattr(l, "shape") and getattr(l, "size", 1) != 0]
+    if not leaves:
+        return
+
+    def get_first(leaf):
+        jax.device_get(leaf if leaf.ndim == 0 else leaf.ravel()[0])
+
+    if len(leaves) == 1:
+        get_first(leaves[0])
+        return
+    # one probe per device group: jit refuses mixed-device argument lists
+    # (e.g. PipelineCoordinator.join fencing per-stage trees placed
+    # round-robin across devices)
+    groups = {}
+    for leaf in leaves:
+        try:
+            key = frozenset(leaf.devices())
+        except Exception:
+            key = None
+        groups.setdefault(key, []).append(leaf)
+    for key, group in groups.items():
+        if key is None or len(key) != 1 or len(group) == 1:
+            # unknown placement or sharded across devices: the safe
+            # per-leaf path (still one RTT per leaf, but only for these)
+            for leaf in group:
+                get_first(leaf)
+        else:
+            jax.device_get(_probe(group))
