@@ -67,7 +67,13 @@ def hard_fence(tree) -> None:
     groups = {}
     for leaf in leaves:
         try:
-            key = frozenset(leaf.devices())
+            # extended dtypes (typed PRNG keys) can't astype to f32 inside
+            # the probe — keep them on the per-leaf path
+            if not (jnp.issubdtype(leaf.dtype, jnp.number)
+                    or jnp.issubdtype(leaf.dtype, jnp.bool_)):
+                key = None
+            else:
+                key = frozenset(leaf.devices())
         except Exception:
             key = None
         groups.setdefault(key, []).append(leaf)
