@@ -111,29 +111,41 @@ def _op_rows(parsed):
     for tab in tables:
         if not isinstance(tab, dict) or "cols" not in tab:
             continue
-        labels = [(c.get("label") or c.get("id") or "").lower()
-                  for c in tab["cols"]]
+        ids = [(c.get("id") or "").lower() for c in tab["cols"]]
+        labels = [(c.get("label") or "").lower() for c in tab["cols"]]
 
         def find(*cands):
+            # exact column-id match first ("operation" must not hit the
+            # "type" column whose LABEL is "Operation Type"), then a
+            # substring fallback over ids+labels for other xprof versions
             for cand in cands:
-                for i, lab in enumerate(labels):
-                    if cand in lab:
+                if cand in ids:
+                    return ids.index(cand)
+            for cand in cands:
+                for i, (cid, lab) in enumerate(zip(ids, labels)):
+                    if cand in cid or cand.replace("_", " ") in lab:
                         return i
             return None
-        c_name = find("operation", "op name", "op_name")
-        c_time = find("total self", "self time", "self_time", "self-time")
+        c_name = find("operation", "op_name")
+        c_time = find("total_self_time", "self_time")
+        c_side = find("host_or_device")
+        c_type = find("type")
         if c_name is None or c_time is None:
             continue
         for row in tab.get("rows", []):
-            cells = row.get("c", [])
+            # gviz rows may carry null cells in columns we never read
+            cells = [(c or {}).get("v") for c in row.get("c", [])]
             if len(cells) <= max(c_name, c_time):
                 continue
-            name = cells[c_name].get("v")
-            t = cells[c_time].get("v")
+            if c_side is not None and cells[c_side] != "Device":
+                continue
+            if c_type is not None and cells[c_type] == "IDLE":
+                continue
+            name, t = cells[c_name], cells[c_time]
             if isinstance(name, str) and isinstance(t, (int, float)):
                 out.append((name, float(t)))
         if out:
-            break  # device table only — host ops are not chip time
+            break  # device rows of the first parseable table
     if not out:
         raise SystemExit(
             f"could not parse framework_op_stats payload: "

@@ -293,7 +293,13 @@ class PipelineStage:
         most recent microbatch through the eager fenced
         :class:`~dcnn_tpu.train.profiling.LayerProfiler` — a profiling run
         at the reference's cost model (its stages time layer-by-layer with
-        device syncs too). Repeated calls accumulate (CUMULATIVE mode);
+        device syncs too). Replay-vs-fused skew quantified once in
+        RESULTS.md "Replay-vs-fused profiling skew" (ResNet-9: Spearman
+        rank corr 0.44-0.51 vs the xprof trace; the replay over-credits
+        elementwise/BN layers that XLA fuses into convs, and per-layer
+        fence floors compress the spread on tunnelled hosts) — use these
+        tables for inter-block load ratios, xprof for true time
+        attribution. Repeated calls accumulate (CUMULATIVE mode);
         :meth:`clear_profile` resets. Returns a JSON-serializable dict:
         ``{"stage_id", "layers": [{"name","fwd_us","bwd_us","calls"}, ...]}``
         with empty layers if no microbatch has been processed yet."""
