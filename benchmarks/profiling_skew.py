@@ -122,8 +122,11 @@ def _op_rows(parsed):
                 if cand in ids:
                     return ids.index(cand)
             for cand in cands:
+                spaced = cand.replace("_", " ")
+                hyphened = cand.replace("_", "-")
                 for i, (cid, lab) in enumerate(zip(ids, labels)):
-                    if cand in cid or cand.replace("_", " ") in lab:
+                    if (cand in cid or spaced in lab or hyphened in lab
+                            or spaced.replace(" time", "-time") in lab):
                         return i
             return None
         c_name = find("operation", "op_name")
@@ -132,10 +135,11 @@ def _op_rows(parsed):
         c_type = find("type")
         if c_name is None or c_time is None:
             continue
+        used = [i for i in (c_name, c_time, c_side, c_type) if i is not None]
         for row in tab.get("rows", []):
             # gviz rows may carry null cells in columns we never read
             cells = [(c or {}).get("v") for c in row.get("c", [])]
-            if len(cells) <= max(c_name, c_time):
+            if len(cells) <= max(used):
                 continue
             if c_side is not None and cells[c_side] != "Device":
                 continue

@@ -101,3 +101,60 @@ def test_streaming_geometry_validation():
     with pytest.raises(ValueError, match="exactly"):
         step(ts, jnp.asarray(x[:24]), jnp.asarray(y[:24].astype(np.int32)),
              jax.random.PRNGKey(1), 0.05)
+
+
+def test_streaming_producer_failure_propagates():
+    """A producer-side failure (raising shards()) must surface as a
+    re-raised exception in the consumer, not a silent hang or a missing
+    epoch (review r5: the sentinel carries the exception)."""
+    x, y = _blobs(n=70, seed=2)
+    model = _model()
+    opt = SGD(0.05)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    ds = StreamingDeviceDataset(x, y, 4, batch_size=8, shard_batches=4)
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad_shards():
+        yield next(iter(ds.__class__.shards(ds)))
+        raise Boom("host feed died")
+    ds.shards = bad_shards
+    step = make_shard_step(model, softmax_cross_entropy, opt, num_classes=4,
+                           batch_size=8, shard_batches=4)
+    import time
+    t0 = time.perf_counter()
+    with pytest.raises(Boom, match="host feed died"):
+        train_streaming_epoch(step, ts, ds, jax.random.PRNGKey(1), 0.05)
+    # must fail promptly (the old code would park 60 s in join or forever
+    # in q.get)
+    assert time.perf_counter() - t0 < 30.0
+
+
+def test_streaming_consumer_failure_unblocks_producer():
+    """If the training step raises, the producer thread must exit quickly
+    (stop-event checked inside its blocking put) instead of pinning staged
+    device buffers forever."""
+    import threading
+
+    x, y = _blobs(n=134, seed=3)   # 4 shards of 32
+    model = _model()
+    opt = SGD(0.05)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    ds = StreamingDeviceDataset(x, y, 4, batch_size=8, shard_batches=4)
+
+    calls = {"n": 0}
+
+    def bad_step(ts, sx, sy, rng, lr):
+        calls["n"] += 1
+        raise ValueError("consumer died")
+    n0 = threading.active_count()
+    with pytest.raises(ValueError, match="consumer died"):
+        train_streaming_epoch(bad_step, ts, ds, jax.random.PRNGKey(1), 0.05)
+    assert calls["n"] == 1
+    # the producer must have exited (join succeeded inside the finally)
+    import time
+    deadline = time.time() + 10
+    while threading.active_count() > n0 and time.time() < deadline:
+        time.sleep(0.1)
+    assert threading.active_count() <= n0

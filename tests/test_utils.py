@@ -104,3 +104,40 @@ def test_hardware_info_collect_keys():
     assert isinstance(info["devices"], list) and info["devices"]
     assert info["default_backend"]
     assert get_memory_usage_kb() > 0
+
+
+def test_hard_fence_tree_shapes_and_dtypes():
+    """hard_fence must handle every leaf shape/dtype the framework fences:
+    multi-leaf trees (single jitted probe), typed PRNG keys (extended dtype
+    routed to the per-leaf path), bools/ints, scalars, empty leaves, and
+    plain numpy leaves (review r5 regressions)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dcnn_tpu.core.fence import hard_fence
+
+    hard_fence({})                                   # empty tree
+    hard_fence(jnp.ones(3))                          # single leaf
+    hard_fence({"a": jnp.ones(3), "b": jnp.zeros((2, 2)),
+                "c": jnp.asarray(1), "d": jnp.asarray(True),
+                "e": jnp.ones(0), "f": np.ones(2),
+                "rng": jax.random.key(0),            # extended dtype
+                "rngs": jax.random.split(jax.random.key(1), 3)})
+
+
+def test_hard_fence_cross_device_tree():
+    """Leaves committed to different devices fence without a jit
+    mixed-device error (PipelineCoordinator.join's shape of tree)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.core.fence import hard_fence
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest
+        pytest.skip("needs 2 devices")
+    tree = [jax.device_put(jnp.ones(3) * i, devs[i % len(devs)])
+            for i in range(4)]
+    hard_fence(tree)
