@@ -33,17 +33,16 @@ host loader for datasets that exceed HBM (tunnel-constrained here, h2d_gbps
 reported for context).
 
 Env knobs: BENCH_MODEL (resnet18 default | resnet50), BENCH_BATCH (default
-1024), BENCH_STEPS (default 20), BENCH_REPS (default 3), DCNN_PRECISION
-(default bf16 = mixed-precision activations; "fast" = bf16 MXU with fp32
-storage; "parity" for fp32), BENCH_CHUNK (train steps per device dispatch
-via the in-jit train loop train.make_multi_step; default 20 — the r3 sweep
-showed 10 -> 23.9k and 20/50 within noise of each other on the tunnelled
-v5e host [absolute sweep values ran high vs the reproducible driver band,
-see RESULTS.md reconciliation]; the in-jit loop amortizes per-dispatch
-launch latency), BENCH_FORMAT (NHWC default —
-TPU-preferred tiling), BENCH_MATRIX=1 for the layout/dtype sweep,
-BENCH_RESIDENT_SAMPLES (resident-path dataset size, default 50 batches),
-BENCH_PROFILE=/path to dump a jax.profiler trace.
+2048 — re-measured best in r5 after the one-pass BN rewrite), BENCH_STEPS
+(default 40), BENCH_REPS (default 5), DCNN_PRECISION (default bf16 =
+mixed-precision activations; "fast" = bf16 MXU with fp32 storage; "parity"
+for fp32), BENCH_CHUNK (train steps per device dispatch via the in-jit
+train loop train.make_multi_step; default 40 — r5: 26.2-26.4k vs 25.3k at
+chunk 20, batch 2048; the in-jit loop amortizes per-dispatch launch
+latency), BENCH_FORMAT (NHWC default — TPU-preferred tiling),
+BENCH_MATRIX=1 for the layout/dtype sweep, BENCH_RESIDENT_SAMPLES
+(resident-path dataset size, default 51200), BENCH_PROFILE=/path to dump a
+jax.profiler trace.
 """
 
 from __future__ import annotations
@@ -194,8 +193,9 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
         from dcnn_tpu.core.fence import hard_fence as _hf
         from dcnn_tpu.data.device_dataset import make_resident_epoch
 
-        n_res = int(os.environ.get("BENCH_RESIDENT_SAMPLES",
-                                   str(batch * 50)))
+        # fixed default (not batch-scaled): same resident working set and
+        # compile size across headline-batch changes
+        n_res = int(os.environ.get("BENCH_RESIDENT_SAMPLES", "51200"))
         n_res = max((n_res // batch) * batch, batch)
         rng_np = np.random.default_rng(1)
         x_res = jnp.asarray(rng_np.integers(
@@ -364,10 +364,13 @@ def main() -> None:
     enable_compile_cache()
 
     root = os.path.dirname(os.path.abspath(__file__))
-    # 1024 measured best on v5e (22.4k img/s / 37% MFU vs 21.2k at 512,
-    # 21.5k at 2048)
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # batch 2048 re-measured best in r5 (26.2-26.4k img/s / 43.5-43.7% MFU
+    # vs ~24.0k median at 1024): the r3 one-pass BN rewrite moved the
+    # optimum up from the r2 sweep's 1024 (2048 amortizes weight-grad
+    # reductions and fills conv tiles better), and the 2x-longer dispatch
+    # also halves the tunnel-RTT share of each rep (variance study)
+    batch = int(os.environ.get("BENCH_BATCH", "2048"))
+    steps = int(os.environ.get("BENCH_STEPS", "40"))
     # 5 reps (r5, was 3): each rep is ONE 20-step dispatch (~0.85 s) whose
     # wall carries the tunnel's dispatch+fence RTT noise (±1.2% CV,
     # strictly additive) — best-of-N is the right estimator and N=5
@@ -376,11 +379,11 @@ def main() -> None:
     reps = int(os.environ.get("BENCH_REPS", "5"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
     profile_dir = os.environ.get("BENCH_PROFILE")
-    # default 20 steps per dispatch (r3 sweep on the tunnelled v5e host:
-    # chunk 10 -> 23.9k, 20 -> 26.9k, 50 -> 27.0k img/s; 20 is within noise
-    # of 50 at 2.5x less staged-batch memory) — per-dispatch launch latency
-    # rides the tunnel and the in-jit multi-step loop amortizes it
-    chunk = int(os.environ.get("BENCH_CHUNK", "20"))
+    # default 40 steps per dispatch (r5: chunk 40 at batch 2048 -> 26.2-26.4k
+    # vs 25.3-25.4k at chunk 20; the in-jit multi-step loop amortizes the
+    # tunnelled per-dispatch launch latency, and the bigger program is still
+    # a ~2-4 min one-time compile served by the persistent cache)
+    chunk = int(os.environ.get("BENCH_CHUNK", "40"))
 
     (img_per_sec, sec_per_step, tflops, pipeline_ips, h2d_gbps,
      resident_ips, streaming_ips, overlap_eff, phases,
