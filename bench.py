@@ -37,7 +37,7 @@ Env knobs: BENCH_MODEL (resnet18 default | resnet50), BENCH_BATCH (default
 (default 40), BENCH_REPS (default 5), DCNN_PRECISION (default bf16 =
 mixed-precision activations; "fast" = bf16 MXU with fp32 storage; "parity"
 for fp32), BENCH_CHUNK (train steps per device dispatch via the in-jit
-train loop train.make_multi_step; default 40 — r5: 26.2-26.4k vs 25.3k at
+train loop train.make_multi_step; default 40 — r5: 26.2-26.5k vs 25.3k at
 chunk 20, batch 2048; the in-jit loop amortizes per-dispatch launch
 latency), BENCH_FORMAT (NHWC default — TPU-preferred tiling),
 BENCH_MATRIX=1 for the layout/dtype sweep, BENCH_RESIDENT_SAMPLES
@@ -179,6 +179,11 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
     phases = {"compile_s": round(compile_s, 3), "warmup_s": round(warmup_s, 3),
               "rep_s": [round(r, 4) for r in rep_times],
               "steps_per_rep": steps}
+    # release the headline working set (the staged K-batch chunk is ~4 GB
+    # fp32 at batch 4096×20) before the feed sections allocate their own —
+    # holding both exceeds HBM at the larger default batch
+    x = y = xs = ys = step = None
+    del ts
 
     resident_img_per_sec = None
     if pipeline and os.environ.get("BENCH_RESIDENT", "1") != "0":
@@ -364,25 +369,29 @@ def main() -> None:
     enable_compile_cache()
 
     root = os.path.dirname(os.path.abspath(__file__))
-    # batch 2048 re-measured best in r5 (26.2-26.4k img/s / 43.5-43.7% MFU
-    # vs ~24.0k median at 1024): the r3 one-pass BN rewrite moved the
-    # optimum up from the r2 sweep's 1024 (2048 amortizes weight-grad
-    # reductions and fills conv tiles better), and the 2x-longer dispatch
-    # also halves the tunnel-RTT share of each rep (variance study)
+    # batch 2048 default, re-measured in r5 (26.2-26.5k img/s / 43.4-43.9%
+    # MFU over six full runs; ≈24.2k median at the old 1024 default): the
+    # r3 one-pass BN rewrite moved the optimum up from the r2 sweep's 1024
+    # — bigger batches fill conv tiles better and amortize weight-grad
+    # reductions — and multi-second dispatches drown the tunnel-RTT share
+    # of each rep (variance study). BENCH_BATCH=4096 with BENCH_CHUNK=20
+    # measures another +1% (26.67-26.72k, 44.2% MFU, headline section
+    # only) but its resident-section compiles blow the full-run wall past
+    # 30 min on this host, so 2048 stays the default.
     batch = int(os.environ.get("BENCH_BATCH", "2048"))
     steps = int(os.environ.get("BENCH_STEPS", "40"))
-    # 5 reps (r5, was 3): each rep is ONE 20-step dispatch (~0.85 s) whose
-    # wall carries the tunnel's dispatch+fence RTT noise (±1.2% CV,
-    # strictly additive) — best-of-N is the right estimator and N=5
-    # tightens it at ~3 s extra cost (variance study,
-    # benchmarks/results_variance.json)
+    # 5 reps (r5, was 3): each rep's wall carries the tunnel's
+    # dispatch+fence RTT noise, which is strictly additive — best-of-N is
+    # the right estimator and N=5 tightens it for a few seconds of extra
+    # cost. (The study in benchmarks/results_variance.json measured ±1.2%
+    # rep CV at the old 0.85-s single-dispatch reps; the current 3.1-s
+    # 40-step dispatches shrink the RTT share further.)
     reps = int(os.environ.get("BENCH_REPS", "5"))
     data_format = os.environ.get("BENCH_FORMAT", "NHWC")
     profile_dir = os.environ.get("BENCH_PROFILE")
-    # default 40 steps per dispatch (r5: chunk 40 at batch 2048 -> 26.2-26.4k
-    # vs 25.3-25.4k at chunk 20; the in-jit multi-step loop amortizes the
-    # tunnelled per-dispatch launch latency, and the bigger program is still
-    # a ~2-4 min one-time compile served by the persistent cache)
+    # default 40 steps per dispatch (r5: chunk 40 at batch 2048 ->
+    # 26.2-26.5k vs 25.3-25.4k at chunk 20; the in-jit multi-step loop
+    # amortizes the tunnelled per-dispatch launch latency)
     chunk = int(os.environ.get("BENCH_CHUNK", "40"))
 
     (img_per_sec, sec_per_step, tflops, pipeline_ips, h2d_gbps,
