@@ -16,12 +16,15 @@ Env: SEQ_LEN (default 2048), EMBED (128), HEADS (2 — head_dim 64 is the
 lane-friendly TPU shape; smaller head dims at long S take the automatic
 blockwise fallback, see ops.attention._flash_geometry_safe), BATCH (32),
 STEPS_PER_EPOCH (60), EPOCHS (8), NUM_CLASSES (16), CURRICULUM
-("S:epochs", e.g. "2048:3" — progressive length extension: train the
-retrieval circuit at a short length first, then continue at SEQ_LEN with
-the same weights. The attention stack carries no positional parameters, so
-the content-based marker-retrieval circuit transfers across lengths;
-from-scratch training at S=8192 sits at chance because the gradient
-through the 1/8192-diluted softmax is too weak to bootstrap the circuit).
+("S:epochs" phases, comma-separated — progressive length extension: train
+the retrieval circuit at a short length first, then continue at SEQ_LEN
+with the same weights. **Defaults to "2048:5" whenever SEQ_LEN > 2048**;
+pass CURRICULUM="" to disable. The attention stack carries no positional
+parameters, so the content-based marker-retrieval circuit transfers across
+lengths; from-scratch training at S=8192 sits at chance because the
+gradient through the 1/8192-diluted softmax is too weak to bootstrap the
+circuit — the default exists because the measured alternative is a run
+that never learns).
 
 Measured (v5e, bf16): defaults (S=2048, B=32) reach 100% fresh-data
 accuracy by epoch 5 at ~34-49 ms/step (1.34-1.95M tokens/s);
@@ -148,9 +151,14 @@ def main():
             return jnp.mean(jnp.argmax(logits, -1) == jnp.argmax(y, -1))
         return step, eval_acc
 
-    # progressive length extension: optional short-S phase(s) first
+    # progressive length extension: short-S phase(s) first. Default one
+    # 2048-length phase whenever the target length exceeds 2048 — measured
+    # necessary: from-scratch at S=8192 sits at chance indefinitely, while
+    # the curriculum transfers the length-invariant circuit immediately
+    # (RESULTS.md "S=8192 task mastery"). CURRICULUM="" disables.
+    default_cur = "2048:5" if S > 2048 else ""
     phases = []
-    for spec in filter(None, get_env("CURRICULUM", "").split(",")):
+    for spec in filter(None, get_env("CURRICULUM", default_cur).split(",")):
         s_c, ep_c = spec.split(":")
         phases.append((int(s_c), int(ep_c)))
     phases.append((S, epochs))
