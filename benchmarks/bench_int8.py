@@ -1,0 +1,172 @@
+"""int8 PTQ benchmark: MXU int8 convs vs bf16, and end-to-end quantized
+ResNet-18 inference vs the BN-folded float graph.
+
+Beyond the reference (no quantized path there); the measurement behind
+``ops/quant.py`` / ``nn/quantize.py``. v5e book peak for int8 is ~394 TOP/s —
+2× the bf16 197 TFLOP/s — and XLA lowers int8 ``conv_general_dilated`` with
+``preferred_element_type=int32`` onto it directly.
+
+Gates: the int8 conv kernel is EXACT integer arithmetic, gated elementwise
+against a float64 torch conv of the same int values (products ≤ 127², sums
+≤ K·127² ≪ 2⁵³ — the double oracle is exact); the end-to-end quantized model
+is gated on logit cosine + top-1 agreement against the float folded model on
+a briefly-trained net (PTQ is lossy by design; exactness lives in the kernel
+gate, fidelity in the model gate).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from common import Result, dep_feed, print_table, report, time_chained, tiny_mode
+
+# (cin, cout, hw) 3×3 s1 p1 ResNet-18 body shapes (the stem is
+# channel-starved in any dtype; the body is where the MXU time goes)
+SHAPES = [(64, 64, 64), (256, 256, 16), (512, 512, 8)]
+
+
+def _torch_conv_int_exact(x_q, w_q, stride, pad):
+    import torch
+
+    with torch.no_grad():
+        out = torch.nn.functional.conv2d(
+            torch.from_numpy(x_q.astype(np.float64)),
+            torch.from_numpy(w_q.astype(np.float64)),
+            stride=stride, padding=pad)
+    return out.numpy().astype(np.int64)
+
+
+def _conv_micro(results, rng, batch, length):
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.core.precision import set_precision
+    from dcnn_tpu.ops import conv as conv_ops
+
+    oracle_batch = 4
+    for cin, cout, hw in (SHAPES[:1] if tiny_mode() else SHAPES):
+        x_q = rng.integers(-127, 128, (batch, cin, hw, hw)).astype(np.int8)
+        w_q = rng.integers(-127, 128, (cout, cin, 3, 3)).astype(np.int8)
+        dx, dw = jax.device_put(x_q), jax.device_put(w_q)
+        flops = 2.0 * batch * cout * cin * 9 * hw * hw
+        tag = f"{cin}x{hw}x{hw}->{cout}"
+
+        fwd8 = jax.jit(lambda xx, ww: conv_ops.conv2d_int8(
+            xx, ww, stride=1, padding=1, data_format="NCHW"))
+        got = np.asarray(fwd8(dx[:oracle_batch], dw), np.int64)
+        want = _torch_conv_int_exact(x_q[:oracle_batch], w_q, 1, 1)
+        ok = bool(np.array_equal(got, want))
+        err = float(np.abs(got - want).max()) if not ok else 0.0
+        dt = time_chained(fwd8, (dx, dw), dep_feed(0), length=length)
+        results.append(Result(f"conv_int8_{tag}", dt, flops / dt / 1e12,
+                              "TOP/s", ok, err))
+
+        # bf16 twin of the same shape/feed for the apples-to-apples ratio.
+        # XLA:CPU emulates bf16 orders of magnitude slower than f32, so the
+        # CPU smoke path keeps f32 storage (the ratio is a TPU artifact)
+        set_precision("fast")
+        ftype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+        xb = jax.device_put(rng.standard_normal(
+            (batch, cin, hw, hw)).astype(np.float32)).astype(ftype)
+        wb = jax.device_put((rng.standard_normal(
+            (cout, cin, 3, 3)) / np.sqrt(cin * 9)).astype(np.float32)
+        ).astype(ftype)
+        fwd16 = jax.jit(lambda xx, ww: conv_ops.conv2d(
+            xx, ww, stride=1, padding=1, data_format="NCHW"))
+        dt = time_chained(fwd16, (xb, wb), dep_feed(0), length=length)
+        set_precision("parity")
+        results.append(Result(f"conv_bf16_{tag}", dt, flops / dt / 1e12,
+                              "TFLOP/s", True, 0.0))
+
+
+def _model_end_to_end(results, rng, length):
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.models import create_mnist_trainer, create_resnet18_tiny_imagenet
+    from dcnn_tpu.nn import fold_batchnorm, quantize_model
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train.trainer import create_train_state, make_train_step
+
+    # tiny mode (the CPU smoke path) swaps in the MNIST CNN: the resnet
+    # train-step compiles alone take minutes on a 1-core host, and the
+    # residual-recursion coverage already lives in tests/test_quantize.py
+    if tiny_mode():
+        model, img, cin, n_cls = create_mnist_trainer("NHWC"), 28, 1, 10
+    else:
+        model, img, cin, n_cls = (create_resnet18_tiny_imagenet("NHWC"),
+                                  64, 3, 200)
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    # a few real steps so BN stats/weights are non-trivial and logits
+    # differentiate (the fidelity gate is meaningless on a random net)
+    bs_train = 8 if tiny_mode() else 16
+    for i in range(2 if tiny_mode() else 6):
+        x = jnp.asarray(rng.normal(size=(bs_train, img, img, cin)),
+                        jnp.float32)
+        y = jnp.asarray(np.eye(n_cls, dtype=np.float32)[
+            rng.integers(0, n_cls, size=bs_train)])
+        ts, _, _ = step(ts, x, y, jax.random.fold_in(jax.random.PRNGKey(1), i),
+                        1e-3)
+
+    batch = 16 if tiny_mode() else 256
+    xf = jnp.asarray(rng.normal(size=(batch, img, img, cin)), jnp.float32)
+
+    fmodel, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+    qmodel, qp, qs = quantize_model(model, ts.params, ts.state, xf)
+
+    from dcnn_tpu.core.precision import set_precision
+
+    # the float baseline runs the production inference precision (bf16 mixed
+    # — Sequential casts params/activations at point of use)
+    def fwd_f_impl(xx):
+        return fmodel.apply(fp, fs, xx, training=False)[0]
+
+    def fwd_q_impl(xx):
+        return qmodel.apply(qp, qs, xx, training=False)[0]
+
+    # production inference precision is bf16 mixed; on the CPU smoke path
+    # bf16 is emulated (and glacial), so the float twin stays in fast-f32
+    set_precision("bf16" if jax.default_backend() == "tpu" else "fast")
+    try:
+        fwd_f = jax.jit(fwd_f_impl)
+        fwd_q = jax.jit(fwd_q_impl)
+
+        y_f = np.asarray(fwd_f(xf), np.float64)
+        y_q = np.asarray(fwd_q(xf), np.float64)
+        cos = float((y_f.ravel() @ y_q.ravel())
+                    / (np.linalg.norm(y_f) * np.linalg.norm(y_q) + 1e-12))
+        top1 = float(np.mean(y_f.argmax(-1) == y_q.argmax(-1)))
+        ok = cos > 0.95 and top1 >= 0.85
+
+        dt_f = time_chained(fwd_f, (xf,), dep_feed(0), length=length)
+        dt_q = time_chained(fwd_q, (xf,), dep_feed(0), length=length)
+    finally:
+        set_precision("parity")
+    net = "mnist_cnn" if tiny_mode() else "resnet18"
+    results.append(Result(f"{net}_infer_bf16_folded", dt_f, batch / dt_f,
+                          "img/s", True, 0.0))
+    results.append(Result(f"{net}_infer_int8_ptq", dt_q, batch / dt_q,
+                          "img/s", ok, 1.0 - cos))
+    results.append(Result(f"{net}_int8_speedup", dt_q, dt_f / dt_q,
+                          "x_vs_bf16", ok, 1.0 - top1))
+
+
+def run() -> dict:
+    batch = 16 if tiny_mode() else 128
+    length = 4 if tiny_mode() else 16
+    rng = np.random.default_rng(0)
+    results = []
+    _conv_micro(results, rng, batch, length)
+    _model_end_to_end(results, rng, length)
+    return report("int8", results, meta={"batch": batch})
+
+
+if __name__ == "__main__":
+    doc = run()
+    print_table(doc)
+    sys.exit(0 if doc["all_correct"] else 1)

@@ -24,7 +24,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import print_table
 
 SECTIONS = ("bench_gemm", "bench_conv", "bench_ops", "bench_attention",
-            "bench_serialization", "bench_pipeline", "bench_pallas_conv")
+            "bench_serialization", "bench_pipeline", "bench_pallas_conv",
+            "bench_int8")
 
 
 def main() -> int:

@@ -40,15 +40,14 @@ def _feature_axis(data_format: str) -> int:
     return 0 if data_format == "NCHW" else 2
 
 
-@register_layer("conv2d")
-class Conv2DLayer(ParameterizedLayer):
-    """2-D convolution (reference ``conv2d_layer.tpp:140-241``): on TPU the
-    im2col→GEMM→cnhw→nchw pipeline collapses to one MXU conv."""
+class Conv2DGeometryMixin:
+    """Geometry/config contract shared by ``Conv2DLayer`` and its int8 PTQ
+    twin (``nn/quantize.py``) — one implementation so the two cannot drift.
+    (The twin is deliberately NOT a subclass of ``Conv2DLayer``: the
+    isinstance walks in fold/quantize must not re-capture it.)"""
 
-    def __init__(self, out_channels: int, kernel_size, stride=1, padding=0,
-                 use_bias: bool = True, in_channels: Optional[int] = None,
-                 data_format: str = "NCHW", name: Optional[str] = None):
-        super().__init__(name)
+    def _set_conv_geometry(self, out_channels, kernel_size, stride, padding,
+                           use_bias, in_channels, data_format):
         self.out_channels = int(out_channels)
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
@@ -62,23 +61,6 @@ class Conv2DLayer(ParameterizedLayer):
         if self.in_channels is not None and self.in_channels != cin:
             raise ValueError(f"{self.name}: expected {self.in_channels} input channels, got {cin}")
         return cin
-
-    def init(self, key, input_shape):
-        cin = self._cin(input_shape)
-        self.in_channels = cin
-        fan_in = init.conv_fan_in(cin, self.kernel_size)
-        wkey, bkey = jax.random.split(key)
-        params = {"w": init.kaiming_uniform(
-            wkey, (self.out_channels, cin, *self.kernel_size), fan_in)}
-        if self.use_bias:
-            params["b"] = init.kaiming_uniform(bkey, (self.out_channels,), fan_in)
-        return params, {}
-
-    def apply(self, params, state, x, *, training=False, rng=None):
-        y = conv_ops.conv2d(
-            x, params["w"], params.get("b"),
-            stride=self.stride, padding=self.padding, data_format=self.data_format)
-        return y, state
 
     def output_shape(self, input_shape):
         if self.data_format == "NCHW":
@@ -110,37 +92,23 @@ class Conv2DLayer(ParameterizedLayer):
         }
 
 
-@register_layer("dense")
-class DenseLayer(ParameterizedLayer):
-    """Fully-connected layer (reference ``dense_layer.tpp``): y = x·Wᵀ + b.
-    Weight stored (out, in) like the reference so checkpoints are auditable."""
+class DenseGeometryMixin:
+    """Geometry/config contract shared by ``DenseLayer`` and its int8 PTQ
+    twin (same non-subclassing rationale as ``Conv2DGeometryMixin``)."""
 
-    def __init__(self, out_features: int, use_bias: bool = True,
-                 in_features: Optional[int] = None, name: Optional[str] = None):
-        super().__init__(name)
+    def _set_dense_geometry(self, out_features, use_bias, in_features):
         self.out_features = int(out_features)
         self.use_bias = bool(use_bias)
         self.in_features = in_features
 
-    def init(self, key, input_shape):
+    def _fan_in(self, input_shape: Shape) -> int:
         if len(input_shape) != 1:
             raise ValueError(f"{self.name}: dense expects flat input, got {input_shape}; "
                              "add a Flatten layer first")
         fan_in = input_shape[0]
         if self.in_features is not None and self.in_features != fan_in:
             raise ValueError(f"{self.name}: expected {self.in_features} features, got {fan_in}")
-        self.in_features = fan_in
-        wkey, bkey = jax.random.split(key)
-        params = {"w": init.kaiming_uniform(wkey, (self.out_features, fan_in), fan_in)}
-        if self.use_bias:
-            params["b"] = init.kaiming_uniform(bkey, (self.out_features,), fan_in)
-        return params, {}
-
-    def apply(self, params, state, x, *, training=False, rng=None):
-        y = jnp.matmul(x, params["w"].T, precision=get_precision())
-        if self.use_bias:
-            y = y + params["b"]
-        return y, state
+        return fan_in
 
     def output_shape(self, input_shape):
         return (self.out_features,)
@@ -155,6 +123,62 @@ class DenseLayer(ParameterizedLayer):
         return {"type": self.type_name, "name": self.name,
                 "out_features": self.out_features, "use_bias": self.use_bias,
                 "in_features": self.in_features}
+
+
+@register_layer("conv2d")
+class Conv2DLayer(Conv2DGeometryMixin, ParameterizedLayer):
+    """2-D convolution (reference ``conv2d_layer.tpp:140-241``): on TPU the
+    im2col→GEMM→cnhw→nchw pipeline collapses to one MXU conv."""
+
+    def __init__(self, out_channels: int, kernel_size, stride=1, padding=0,
+                 use_bias: bool = True, in_channels: Optional[int] = None,
+                 data_format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        self._set_conv_geometry(out_channels, kernel_size, stride, padding,
+                                use_bias, in_channels, data_format)
+
+    def init(self, key, input_shape):
+        cin = self._cin(input_shape)
+        self.in_channels = cin
+        fan_in = init.conv_fan_in(cin, self.kernel_size)
+        wkey, bkey = jax.random.split(key)
+        params = {"w": init.kaiming_uniform(
+            wkey, (self.out_channels, cin, *self.kernel_size), fan_in)}
+        if self.use_bias:
+            params["b"] = init.kaiming_uniform(bkey, (self.out_channels,), fan_in)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = conv_ops.conv2d(
+            x, params["w"], params.get("b"),
+            stride=self.stride, padding=self.padding, data_format=self.data_format)
+        return y, state
+
+
+@register_layer("dense")
+class DenseLayer(DenseGeometryMixin, ParameterizedLayer):
+    """Fully-connected layer (reference ``dense_layer.tpp``): y = x·Wᵀ + b.
+    Weight stored (out, in) like the reference so checkpoints are auditable."""
+
+    def __init__(self, out_features: int, use_bias: bool = True,
+                 in_features: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self._set_dense_geometry(out_features, use_bias, in_features)
+
+    def init(self, key, input_shape):
+        fan_in = self._fan_in(input_shape)
+        self.in_features = fan_in
+        wkey, bkey = jax.random.split(key)
+        params = {"w": init.kaiming_uniform(wkey, (self.out_features, fan_in), fan_in)}
+        if self.use_bias:
+            params["b"] = init.kaiming_uniform(bkey, (self.out_features,), fan_in)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.matmul(x, params["w"].T, precision=get_precision())
+        if self.use_bias:
+            y = y + params["b"]
+        return y, state
 
 
 @register_layer("batchnorm")

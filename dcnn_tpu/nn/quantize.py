@@ -1,0 +1,207 @@
+"""Post-training int8 quantization of the inference graph.
+
+Beyond the reference (which has no quantized path — its inference is the
+training graph minus the update), and the natural completion of the
+deployment transform chain started by ``fold_batchnorm``: fold BN into the
+linear layers, then quantize those layers w8a8 for the v5e MXU's int8 mode
+(2× the bf16 peak; kernels and measured numbers in ``ops/quant.py`` /
+``benchmarks/bench_int8.py``).
+
+Recipe (standard static PTQ):
+
+- **Weights**: symmetric int8, per output channel
+  (``ops.quant.quantize_weight``), computed from the folded weights.
+- **Activations**: symmetric int8, per tensor, with a **static** scale
+  calibrated from a representative batch — each quantized layer records the
+  absmax of its own input during a float calibration pass. Static scales
+  keep the quantize op a fused elementwise chain (dynamic ones would add a
+  global reduction before every conv).
+- Everything between the linear layers (pooling, activations, residual adds,
+  softmax) stays in float: the int32 accumulator is dequantized per channel
+  right after each conv/GEMM. This is the robust w8a8 arrangement — the
+  float glue costs HBM traffic the MXU win dwarfs, and it needs no
+  cross-layer scale algebra.
+
+``quantize_model`` mirrors ``fold_batchnorm``'s walk (recursing into
+ResidualBlock main/shortcut paths) and returns a NEW (model, params, state)
+triple; the original objects are untouched. The quantized layers round-trip
+through the layer factory and the checkpoint format like any other layer
+(int8 arrays are ordinary npz entries).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..ops import quant as quant_ops
+from ..ops.conv import conv2d_int8
+from .factory import layer_from_config, register_layer
+from .layer import ParameterizedLayer
+from .layers import (Conv2DGeometryMixin, Conv2DLayer, DenseGeometryMixin,
+                     DenseLayer)
+from .residual import ResidualBlock
+from .sequential import Sequential
+
+
+class _QuantizedLayer(ParameterizedLayer):
+    """Shared plumbing. PTQ layers are materialized by ``quantize_model``;
+    ``init`` produces a deterministic ZERO template with the right
+    shapes/dtypes — that is what ``load_checkpoint`` needs to restore a
+    quantized snapshot (and what a pipeline worker needs to materialize a
+    quantized stage from config + shipped weights). Zero weights make an
+    uninitialized quant layer loudly useless rather than silently random."""
+
+    def _template(self, w_shape, out_ch):
+        params = {"w_q": jnp.zeros(w_shape, jnp.int8),
+                  "w_scale": jnp.ones((out_ch,), jnp.float32),
+                  "x_scale": jnp.ones((), jnp.float32)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((out_ch,), jnp.float32)
+        return params, {}
+
+    def _dequant(self, y_i32, params, x_dtype, *, channel_axis: int):
+        """int32 accumulator → float: per-channel (x_scale · w_scale) multiply
+        + bias, cast back to the activation dtype."""
+        scale = params["x_scale"] * params["w_scale"]
+        shape = [1] * y_i32.ndim
+        shape[channel_axis] = -1
+        y = y_i32.astype(jnp.float32) * scale.reshape(shape)
+        if "b" in params:
+            y = y + params["b"].reshape(shape)
+        return y.astype(x_dtype)
+
+
+@register_layer("quant_conv2d")
+class QuantConv2DLayer(Conv2DGeometryMixin, _QuantizedLayer):
+    """int8 convolution layer produced by PTQ of a (folded) ``Conv2DLayer``.
+
+    Params: ``w_q`` int8 OIHW, ``w_scale`` f32 (O,), ``x_scale`` f32 scalar
+    (calibrated), optional ``b`` f32 (O,). Geometry/config/complexity come
+    from the shared mixin, so shapes and partitioning keep working."""
+
+    def __init__(self, out_channels: int, kernel_size, stride=1, padding=0,
+                 use_bias: bool = True, in_channels: Optional[int] = None,
+                 data_format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        self._set_conv_geometry(out_channels, kernel_size, stride, padding,
+                                use_bias, in_channels, data_format)
+
+    def init(self, key, input_shape):
+        del key
+        cin = self._cin(input_shape)
+        self.in_channels = cin
+        return self._template(
+            (self.out_channels, cin, *self.kernel_size), self.out_channels)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            raise ValueError(f"{self.name}: the PTQ graph is inference-only")
+        x_q = quant_ops.quantize_symmetric(x, params["x_scale"])
+        y = conv2d_int8(x_q, params["w_q"], stride=self.stride,
+                        padding=self.padding, data_format=self.data_format)
+        ch = 1 if self.data_format == "NCHW" else 3
+        return self._dequant(y, params, x.dtype, channel_axis=ch), state
+
+
+@register_layer("quant_dense")
+class QuantDenseLayer(DenseGeometryMixin, _QuantizedLayer):
+    """int8 GEMM layer produced by PTQ of a ``DenseLayer``. Params: ``w_q``
+    int8 (out, in), ``w_scale`` f32 (out,), ``x_scale`` f32 scalar,
+    optional ``b`` f32 (out,)."""
+
+    def __init__(self, out_features: int, use_bias: bool = True,
+                 in_features: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self._set_dense_geometry(out_features, use_bias, in_features)
+
+    def init(self, key, input_shape):
+        del key
+        fan_in = self._fan_in(input_shape)
+        self.in_features = fan_in
+        return self._template((self.out_features, fan_in), self.out_features)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            raise ValueError(f"{self.name}: the PTQ graph is inference-only")
+        x_q = quant_ops.quantize_symmetric(x, params["x_scale"])
+        y = quant_ops.dense_int8(x_q, params["w_q"])
+        return self._dequant(y, params, x.dtype,
+                             channel_axis=y.ndim - 1), state
+
+
+def _quantize_linear(layer, lp, x, qcls):
+    """Build the quantized twin of one conv/dense layer from its float
+    params and the calibration activation feeding it."""
+    w_q, w_scale = quant_ops.quantize_weight(lp["w"])
+    qp = {"w_q": w_q, "w_scale": w_scale,
+          "x_scale": quant_ops.tensor_scale(x)}
+    if "b" in lp:
+        qp["b"] = jnp.asarray(lp["b"], jnp.float32)
+    cfg = layer.get_config()
+    cfg.pop("type")
+    return qcls(**cfg), qp
+
+
+def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x
+                   ) -> Tuple[List, List, List, Any]:
+    """Walk one layer list: emit quantized twins for Conv2D/Dense (recording
+    each one's calibrated input scale), recurse into residual blocks, copy
+    everything else — while advancing the calibration activation ``x``
+    through the ORIGINAL float layers (eval mode), so every scale is
+    measured on exactly the tensor the quantized layer will see."""
+    out_l: List[Any] = []
+    out_p: List[Any] = []
+    out_s: List[Any] = []
+    for layer, lp, ls in zip(layers, params, state):
+        if isinstance(layer, Conv2DLayer):
+            ql, qp = _quantize_linear(layer, lp, x, QuantConv2DLayer)
+            out_l.append(ql)
+            out_p.append(qp)
+            out_s.append({})
+        elif isinstance(layer, DenseLayer):
+            ql, qp = _quantize_linear(layer, lp, x, QuantDenseLayer)
+            out_l.append(ql)
+            out_p.append(qp)
+            out_s.append({})
+        elif isinstance(layer, ResidualBlock):
+            ml, mp, ms, _ = _quantize_list(layer.layers, lp["main"],
+                                           ls["main"], x)
+            sl, sp, ss, _ = _quantize_list(layer.shortcut, lp["shortcut"],
+                                           ls["shortcut"], x)
+            out_l.append(ResidualBlock(ml, sl, activation=layer.activation,
+                                       name=layer.name))
+            out_p.append({"main": tuple(mp), "shortcut": tuple(sp)})
+            out_s.append({"main": tuple(ms), "shortcut": tuple(ss)})
+        else:
+            out_l.append(layer_from_config(layer.get_config()))
+            out_p.append(lp)
+            out_s.append(ls)
+        x, _ = layer.apply(lp, ls, x, training=False)
+    return out_l, out_p, out_s, x
+
+
+def quantize_model(model: Sequential, params, state, calib_x, *,
+                   fold_bn: bool = True
+                   ) -> Tuple[Sequential, Any, Any]:
+    """Return (qmodel, qparams, qstate): the int8 PTQ twin of ``model``.
+
+    ``calib_x`` is a representative input batch in the SAME preprocessing the
+    eval path uses (decode/scale/normalize) — activation scales are absmax
+    over this batch, so it should cover the data's dynamic range (a few
+    hundred samples is plenty for the absmax statistic).
+
+    ``fold_bn`` (default) first runs :func:`~dcnn_tpu.nn.fold.fold_batchnorm`
+    — quantizing *folded* weights is the standard order (BN rescales per
+    channel; folding first lets the per-channel weight scales absorb it).
+    """
+    from .fold import fold_batchnorm
+
+    if fold_bn:
+        model, params, state = fold_batchnorm(model, params, state)
+    layers, qp, qs, _ = _quantize_list(model.layers, params, state, calib_x)
+    qmodel = Sequential(layers, name=f"{model.name}_int8",
+                        input_shape=model.input_shape)
+    return qmodel, tuple(qp), tuple(qs)

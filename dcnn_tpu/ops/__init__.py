@@ -16,7 +16,8 @@ from .activations import (
     elu, leaky_relu, linear, relu, sigmoid, softmax, tanh,
     ACTIVATIONS, apply_activation,
 )
-from .conv import conv2d, conv2d_input_grad, conv2d_weight_grad
+from . import quant
+from .conv import conv2d, conv2d_input_grad, conv2d_int8, conv2d_weight_grad
 from .pool import avg_pool2d, max_pool2d
 from .norm import batch_norm, group_norm
 from .losses import (
@@ -30,7 +31,8 @@ __all__ = [
     "elementwise",
     "relu", "leaky_relu", "elu", "sigmoid", "tanh", "softmax", "linear",
     "ACTIVATIONS", "apply_activation",
-    "conv2d", "conv2d_input_grad", "conv2d_weight_grad",
+    "quant",
+    "conv2d", "conv2d_input_grad", "conv2d_int8", "conv2d_weight_grad",
     "max_pool2d", "avg_pool2d",
     "batch_norm", "group_norm",
     "cross_entropy", "softmax_cross_entropy", "log_softmax_cross_entropy",

@@ -74,6 +74,34 @@ def conv2d(
     return out
 
 
+def conv2d_int8(
+    x_q: jax.Array,
+    w_q: jax.Array,
+    *,
+    stride: IntOrPair = 1,
+    padding: IntOrPair = 0,
+    data_format: str = "NCHW",
+) -> jax.Array:
+    """int8 × int8 → int32 convolution on the MXU's int8 path (~2× the bf16
+    peak on v5e; measured in ``benchmarks/bench_int8.py``). Same geometry
+    contract as :func:`conv2d` (OIHW weights, symmetric int padding); the
+    caller owns the scales — dequantization is a per-channel multiply on the
+    int32 output (``nn/quantize.py``). No ``precision`` arg: precision
+    selects float MXU passes and does not apply to integer convs."""
+    if x_q.dtype != jnp.int8 or w_q.dtype != jnp.int8:
+        raise TypeError(f"conv2d_int8 expects int8 operands, got "
+                        f"{x_q.dtype}/{w_q.dtype}")
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    return lax.conv_general_dilated(
+        x_q, w_q,
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        dimension_numbers=_dims(data_format),
+        preferred_element_type=jnp.int32,
+    )
+
+
 def conv2d_weight_grad(
     x: jax.Array,
     grad_out: jax.Array,

@@ -1,0 +1,217 @@
+"""int8 PTQ tests (ops/quant.py + nn/quantize.py).
+
+Beyond-reference feature (the reference has no quantized path). Contracts:
+kernel-level int8 conv/GEMM agree with a numpy dequantized oracle exactly;
+the quantized model tracks the float folded model closely on realistic
+trained-ish weights (logit cosine + top-1 agreement, not exact equality —
+int8 is lossy by design); configs/params round-trip through the factory and
+checkpoint; training through a PTQ graph is refused.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.nn import (
+    QuantConv2DLayer, QuantDenseLayer, Sequential, SequentialBuilder,
+    layer_from_config, quantize_model,
+)
+from dcnn_tpu.ops import conv2d, conv2d_int8
+from dcnn_tpu.ops import quant as quant_ops
+
+from test_fold import _train_a_bit
+
+
+def _quant_layer_count(layers):
+    n = 0
+    for l in layers:
+        if isinstance(l, (QuantConv2DLayer, QuantDenseLayer)):
+            n += 1
+        if hasattr(l, "layers") and hasattr(l, "shortcut"):
+            n += _quant_layer_count(l.layers) + _quant_layer_count(l.shortcut)
+    return n
+
+
+# ---------------------------------------------------------------- kernels
+
+def test_quantize_symmetric_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)) * 3.0
+    s = quant_ops.tensor_scale(x)
+    x_q = quant_ops.quantize_symmetric(x, s)
+    assert x_q.dtype == jnp.int8
+    # symmetric round-to-nearest: |x - s*q| <= s/2 everywhere in range
+    err = np.abs(np.asarray(x) - np.asarray(s) * np.asarray(x_q, np.float32))
+    assert err.max() <= float(s) / 2 + 1e-7
+
+
+def test_channel_scales_zero_channel_guard():
+    w = jnp.zeros((4, 3, 3, 3), jnp.float32)
+    s = quant_ops.channel_scales(w)
+    assert np.all(np.asarray(s) > 0)
+    w_q, _ = quant_ops.quantize_weight(w)
+    assert np.all(np.asarray(w_q) == 0)
+
+
+def test_conv2d_int8_matches_integer_oracle():
+    """int8 conv must be EXACT integer arithmetic (int32 accumulate)."""
+    rng = np.random.default_rng(1)
+    x_q = jnp.asarray(rng.integers(-127, 128, (2, 4, 5, 5), dtype=np.int8))
+    w_q = jnp.asarray(rng.integers(-127, 128, (3, 4, 3, 3), dtype=np.int8))
+    got = conv2d_int8(x_q, w_q, stride=1, padding=1, data_format="NCHW")
+    assert got.dtype == jnp.int32
+    want = conv2d(jnp.asarray(x_q, jnp.float32), jnp.asarray(w_q, jnp.float32),
+                  stride=1, padding=1, data_format="NCHW")
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(want).astype(np.int64))
+
+
+def test_conv2d_int8_rejects_float():
+    x = jnp.zeros((1, 1, 4, 4), jnp.float32)
+    w = jnp.zeros((1, 1, 3, 3), jnp.int8)
+    with pytest.raises(TypeError):
+        conv2d_int8(x, w)
+
+
+def test_dense_int8_matches_integer_oracle():
+    rng = np.random.default_rng(2)
+    x_q = jnp.asarray(rng.integers(-127, 128, (8, 16), dtype=np.int8))
+    w_q = jnp.asarray(rng.integers(-127, 128, (5, 16), dtype=np.int8))
+    got = quant_ops.dense_int8(x_q, w_q)
+    assert got.dtype == jnp.int32
+    want = np.asarray(x_q, np.int64) @ np.asarray(w_q, np.int64).T
+    np.testing.assert_array_equal(np.asarray(got, np.int64), want)
+
+
+# ---------------------------------------------------------------- transform
+
+def _agreement(model, ts, qm, qp, qs, bs=16, seed=7):
+    x = jnp.asarray(np.random.default_rng(seed).normal(
+        size=(bs, *model.input_shape)).astype(np.float32))
+    y0, _ = model.apply(ts.params, ts.state, x, training=False)
+    y1, _ = qm.apply(qp, qs, x, training=False)
+    y0, y1 = np.asarray(y0, np.float64), np.asarray(y1, np.float64)
+    cos = (y0.ravel() @ y1.ravel()) / (
+        np.linalg.norm(y0) * np.linalg.norm(y1) + 1e-12)
+    top1 = float(np.mean(y0.argmax(-1) == y1.argmax(-1)))
+    return cos, top1
+
+
+def test_quantize_conv_bn_dense_model():
+    model = (SequentialBuilder(name="qcbn", data_format="NHWC")
+             .input((8, 8, 3))
+             .conv2d(16, 3, padding=1).batchnorm().activation("relu")
+             .conv2d(8, 3, padding=1, use_bias=False).batchnorm()
+             .activation("relu")
+             .maxpool2d(2).flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    calib = jnp.asarray(np.random.default_rng(3).normal(
+        size=(32, 8, 8, 3)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+    assert _quant_layer_count(qm.layers) == 3  # 2 convs + 1 dense
+    # folded-then-quantized: the bias-less second conv (index 2 after the
+    # BN layers fold away) carries the BN shift as its bias
+    assert "b" in qp[2] and qp[2]["w_q"].dtype == jnp.int8
+    cos, top1 = _agreement(model, ts, qm, qp, qs)
+    assert cos > 0.995, f"logit cosine {cos}"
+    assert top1 >= 0.9, f"top-1 agreement {top1}"
+
+
+def test_quantize_residual_recursion():
+    from dcnn_tpu.models import create_resnet9_cifar10
+
+    model = create_resnet9_cifar10("NHWC")
+    ts = _train_a_bit(model, n_steps=3, bs=4)
+    calib = jnp.asarray(np.random.default_rng(4).normal(
+        size=(8, 32, 32, 3)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+    assert _quant_layer_count(qm.layers) >= 8  # all resnet9 convs + head
+    cos, _ = _agreement(model, ts, qm, qp, qs, bs=8)
+    assert cos > 0.98, f"logit cosine {cos}"
+
+
+def test_quantize_without_fold():
+    model = (SequentialBuilder(name="nofold", data_format="NHWC")
+             .input((6, 6, 1))
+             .conv2d(4, 3, padding=1).activation("relu").flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    calib = jnp.asarray(np.random.default_rng(5).normal(
+        size=(16, 6, 6, 1)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib,
+                                fold_bn=False)
+    cos, _ = _agreement(model, ts, qm, qp, qs)
+    assert cos > 0.995
+
+
+def test_quantized_model_refuses_training():
+    model = (SequentialBuilder(name="ro", data_format="NHWC")
+             .input((6, 6, 1))
+             .conv2d(4, 3, padding=1).flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    calib = jnp.ones((4, 6, 6, 1), jnp.float32)
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+    with pytest.raises(ValueError, match="inference-only"):
+        qm.apply(qp, qs, calib, training=True)
+    # init is a deterministic ZERO template (the load_checkpoint /
+    # pipeline-worker materialization path), never random weights
+    tp, _ = qm.init(jax.random.PRNGKey(0))
+    assert tp[0]["w_q"].dtype == jnp.int8
+    assert not np.any(np.asarray(tp[0]["w_q"]))
+    assert tp[0]["w_q"].shape == qp[0]["w_q"].shape
+
+
+def test_quantized_config_and_checkpoint_roundtrip(tmp_path):
+    from dcnn_tpu.train import load_checkpoint, save_checkpoint
+
+    model = (SequentialBuilder(name="ckpt", data_format="NHWC")
+             .input((8, 8, 3))
+             .conv2d(8, 3, padding=1, stride=2).batchnorm()
+             .activation("relu").flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    calib = jnp.asarray(np.random.default_rng(6).normal(
+        size=(8, 8, 8, 3)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+
+    # config round-trip through the factory (registry keys quant_conv2d /
+    # quant_dense), matching the pipeline worker materialization path
+    qm2 = Sequential.from_config(qm.get_config())
+    assert [l.type_name for l in qm2.layers] == \
+        [l.type_name for l in qm.layers]
+    assert qm2.layers[0].stride == qm.layers[0].stride
+
+    # checkpoint round-trip: int8 params are ordinary npz entries
+    path = os.path.join(tmp_path, "q")
+    save_checkpoint(path, qm, qp, qs)
+    _, qp2, qs2, _, _, _ = load_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(qp2[0]["w_q"]),
+                                  np.asarray(qp[0]["w_q"]))
+    assert qp2[0]["w_q"].dtype == jnp.int8
+
+    x = jnp.asarray(np.random.default_rng(9).normal(
+        size=(4, 8, 8, 3)).astype(np.float32))
+    y0, _ = qm.apply(qp, qs, x, training=False)
+    y1, _ = qm2.apply(qp2, qs2, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_quantize_does_not_mutate_original():
+    model = (SequentialBuilder(name="orig_q", data_format="NHWC")
+             .input((8, 8, 3))
+             .conv2d(4, 3, padding=1, use_bias=False).batchnorm()
+             .flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    w_before = np.asarray(ts.params[0]["w"]).copy()
+    quantize_model(model, ts.params, ts.state,
+                   jnp.ones((4, 8, 8, 3), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(ts.params[0]["w"]), w_before)
+    assert not model.layers[0].use_bias
