@@ -362,6 +362,48 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
             phases, streaming_timeline)
 
 
+def int8_inference_section(data_format: str):
+    """Deployment-graph throughput: BN-folded bf16 vs int8 PTQ ResNet-18
+    inference (nn.quantize_model; RESULTS.md 'int8 PTQ inference'). Returns
+    (bf16_img_per_sec, int8_img_per_sec). Timing is the shared
+    benchmarks/common.time_chained harness (two-length difference method on
+    TPU, per-dispatch fallback on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from common import dep_feed, time_chained
+
+    from dcnn_tpu.models import create_resnet18_tiny_imagenet
+    from dcnn_tpu.nn import fold_batchnorm, quantize_model
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.train.trainer import create_train_state
+
+    # CPU path (the verify recipe's tiny run) shrinks the problem: a
+    # batch-256 resnet18 chain takes minutes on a 1-core host
+    on_tpu = jax.default_backend() == "tpu"
+    batch = int(os.environ.get("BENCH_INT8_BATCH",
+                               "256" if on_tpu else "8"))
+    length = 256 if on_tpu else 8
+    model = create_resnet18_tiny_imagenet(data_format)
+    ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(3))
+    shape = ((batch, 3, 64, 64) if data_format == "NCHW"
+             else (batch, 64, 64, 3))
+    x = jnp.asarray(np.random.default_rng(5).normal(size=shape),
+                    jnp.float32)
+    fmodel, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+    qmodel, qp, qs = quantize_model(model, ts.params, ts.state, x)
+    dt_f = time_chained(
+        lambda c: fmodel.apply(fp, fs, c, training=False)[0], (x,),
+        dep_feed(0), length=length)
+    dt_q = time_chained(
+        lambda c: qmodel.apply(qp, qs, c, training=False)[0], (x,),
+        dep_feed(0), length=length)
+    return batch / dt_f, batch / dt_q
+
+
 def main() -> None:
     import jax
 
@@ -455,6 +497,14 @@ def main() -> None:
         # RESULTS.md "variance budget" section)
         "phases": phases,
     }
+
+    # deployment-graph inference: BN-folded bf16 vs int8 PTQ (default-on so
+    # the driver capture carries the number; BENCH_INT8=0 opts out)
+    if os.environ.get("BENCH_INT8", "1") == "1":
+        bf16_ips, int8_ips = int8_inference_section(data_format)
+        out["infer_bf16_img_per_sec"] = round(bf16_ips, 1)
+        out["infer_int8_img_per_sec"] = round(int8_ips, 1)
+        out["int8_speedup_x"] = round(int8_ips / bf16_ips, 3)
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision
