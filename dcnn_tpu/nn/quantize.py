@@ -132,12 +132,12 @@ class QuantDenseLayer(DenseGeometryMixin, _QuantizedLayer):
                              channel_axis=y.ndim - 1), state
 
 
-def _quantize_linear(layer, lp, x, qcls):
+def _quantize_linear(layer, lp, x, qcls, act_quantile):
     """Build the quantized twin of one conv/dense layer from its float
     params and the calibration activation feeding it."""
     w_q, w_scale = quant_ops.quantize_weight(lp["w"])
     qp = {"w_q": w_q, "w_scale": w_scale,
-          "x_scale": quant_ops.tensor_scale(x)}
+          "x_scale": quant_ops.tensor_scale(x, quantile=act_quantile)}
     if "b" in lp:
         qp["b"] = jnp.asarray(lp["b"], jnp.float32)
     cfg = layer.get_config()
@@ -145,8 +145,8 @@ def _quantize_linear(layer, lp, x, qcls):
     return qcls(**cfg), qp
 
 
-def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x
-                   ) -> Tuple[List, List, List, Any]:
+def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x,
+                   act_quantile) -> Tuple[List, List, List, Any]:
     """Walk one layer list: emit quantized twins for Conv2D/Dense (recording
     each one's calibrated input scale), recurse into residual blocks, copy
     everything else — while advancing the calibration activation ``x``
@@ -157,20 +157,22 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x
     out_s: List[Any] = []
     for layer, lp, ls in zip(layers, params, state):
         if isinstance(layer, Conv2DLayer):
-            ql, qp = _quantize_linear(layer, lp, x, QuantConv2DLayer)
+            ql, qp = _quantize_linear(layer, lp, x, QuantConv2DLayer,
+                                      act_quantile)
             out_l.append(ql)
             out_p.append(qp)
             out_s.append({})
         elif isinstance(layer, DenseLayer):
-            ql, qp = _quantize_linear(layer, lp, x, QuantDenseLayer)
+            ql, qp = _quantize_linear(layer, lp, x, QuantDenseLayer,
+                                      act_quantile)
             out_l.append(ql)
             out_p.append(qp)
             out_s.append({})
         elif isinstance(layer, ResidualBlock):
             ml, mp, ms, _ = _quantize_list(layer.layers, lp["main"],
-                                           ls["main"], x)
+                                           ls["main"], x, act_quantile)
             sl, sp, ss, _ = _quantize_list(layer.shortcut, lp["shortcut"],
-                                           ls["shortcut"], x)
+                                           ls["shortcut"], x, act_quantile)
             out_l.append(ResidualBlock(ml, sl, activation=layer.activation,
                                        name=layer.name))
             out_p.append({"main": tuple(mp), "shortcut": tuple(sp)})
@@ -184,7 +186,8 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x
 
 
 def quantize_model(model: Sequential, params, state, calib_x, *,
-                   fold_bn: bool = True
+                   fold_bn: bool = True,
+                   act_quantile: Optional[float] = None
                    ) -> Tuple[Sequential, Any, Any]:
     """Return (qmodel, qparams, qstate): the int8 PTQ twin of ``model``.
 
@@ -196,12 +199,18 @@ def quantize_model(model: Sequential, params, state, calib_x, *,
     ``fold_bn`` (default) first runs :func:`~dcnn_tpu.nn.fold.fold_batchnorm`
     — quantizing *folded* weights is the standard order (BN rescales per
     channel; folding first lets the per-channel weight scales absorb it).
+
+    ``act_quantile`` (e.g. 0.9999) switches activation calibration from
+    absmax to an |x| quantile — robust when the calibration batch carries
+    rare outliers that would otherwise stretch every scale
+    (``ops.quant.tensor_scale``).
     """
     from .fold import fold_batchnorm
 
     if fold_bn:
         model, params, state = fold_batchnorm(model, params, state)
-    layers, qp, qs, _ = _quantize_list(model.layers, params, state, calib_x)
+    layers, qp, qs, _ = _quantize_list(model.layers, params, state, calib_x,
+                                       act_quantile)
     qmodel = Sequential(layers, name=f"{model.name}_int8",
                         input_shape=model.input_shape)
     return qmodel, tuple(qp), tuple(qs)

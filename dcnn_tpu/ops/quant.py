@@ -53,9 +53,19 @@ def channel_scales(w: jax.Array, *, floor: float = 1e-8) -> jax.Array:
     return jnp.maximum(absmax, floor) / QMAX
 
 
-def tensor_scale(x: jax.Array, *, floor: float = 1e-8) -> jax.Array:
-    """Per-tensor symmetric scale from an activation sample (calibration)."""
-    return jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32))), floor) / QMAX
+def tensor_scale(x: jax.Array, *, floor: float = 1e-8,
+                 quantile: float | None = None) -> jax.Array:
+    """Per-tensor symmetric scale from an activation sample (calibration).
+
+    ``quantile=None`` (default) uses absmax — exact coverage of the sample's
+    range. A quantile (e.g. 0.9999) clips the top outliers instead: one
+    stray activation otherwise stretches the scale and coarsens every other
+    value's resolution. Calibration is offline, so the O(n log n) quantile
+    sort costs nothing at inference."""
+    a = jnp.abs(x.astype(jnp.float32))
+    amax = jnp.max(a) if quantile is None else jnp.quantile(
+        a.ravel(), quantile)
+    return jnp.maximum(amax, floor) / QMAX
 
 
 def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:

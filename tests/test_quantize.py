@@ -49,6 +49,43 @@ def test_quantize_symmetric_roundtrip_error_bound():
     assert err.max() <= float(s) / 2 + 1e-7
 
 
+def test_tensor_scale_quantile_rejects_outlier():
+    """One stray activation must not stretch the quantile-calibrated scale;
+    bulk quantization error drops accordingly."""
+    rng = np.random.default_rng(8)
+    bulk = rng.normal(size=4095).astype(np.float32)
+    x = jnp.asarray(np.concatenate([bulk, [1000.0]]))
+    s_max = quant_ops.tensor_scale(x)
+    s_q = quant_ops.tensor_scale(x, quantile=0.999)
+    assert float(s_q) < float(s_max) / 50  # outlier rejected
+    # mean bulk error under the quantile scale beats the absmax scale by
+    # a wide margin (values past the quantile clip — that is the tradeoff)
+    errs = {}
+    for name, s in (("max", s_max), ("q", s_q)):
+        xq = quant_ops.quantize_symmetric(jnp.asarray(bulk), s)
+        errs[name] = np.abs(
+            bulk - np.asarray(s) * np.asarray(xq, np.float32)).mean()
+    assert errs["q"] < errs["max"] / 20, errs
+
+
+def test_quantize_model_act_quantile_plumbs_through():
+    model = (SequentialBuilder(name="qq", data_format="NHWC")
+             .input((6, 6, 1))
+             .conv2d(4, 3, padding=1).activation("relu").flatten().dense(10)
+             .build())
+    ts = _train_a_bit(model)
+    calib = np.random.default_rng(10).normal(
+        size=(16, 6, 6, 1)).astype(np.float32)
+    calib[0, 0, 0, 0] = 1e4  # poison one calibration sample
+    qm, qp_max, _ = quantize_model(model, ts.params, ts.state,
+                                   jnp.asarray(calib))
+    # 0.99 of 576 calib elements: the single poisoned element is safely
+    # outside the quantile (0.999 would still interpolate into it)
+    _, qp_q, _ = quantize_model(model, ts.params, ts.state,
+                                jnp.asarray(calib), act_quantile=0.99)
+    assert float(qp_q[0]["x_scale"]) < float(qp_max[0]["x_scale"]) / 10
+
+
 def test_channel_scales_zero_channel_guard():
     w = jnp.zeros((4, 3, 3, 3), jnp.float32)
     s = quant_ops.channel_scales(w)
