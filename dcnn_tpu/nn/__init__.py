@@ -24,7 +24,8 @@ from .sequential import Sequential
 from .factory import LayerFactory, register_layer, layer_from_config
 from .builder import SequentialBuilder
 from .fold import fold_batchnorm
-from .quantize import QuantConv2DLayer, QuantDenseLayer, quantize_model
+from .quantize import (QuantConv2DLayer, QuantDenseLayer,
+                       QuantMultiHeadAttentionLayer, quantize_model)
 
 __all__ = [
     "Layer", "ParameterizedLayer", "StatelessLayer",
@@ -34,5 +35,6 @@ __all__ = [
     "Sequential", "SequentialBuilder",
     "LayerFactory", "register_layer", "layer_from_config",
     "fold_batchnorm",
-    "QuantConv2DLayer", "QuantDenseLayer", "quantize_model",
+    "QuantConv2DLayer", "QuantDenseLayer", "QuantMultiHeadAttentionLayer",
+    "quantize_model",
 ]

@@ -21,8 +21,71 @@ from .factory import register_layer
 from .layer import ParameterizedLayer
 
 
+class MHAGeometryMixin:
+    """Geometry/config + the float attention core shared by
+    ``MultiHeadAttentionLayer`` and its int8 PTQ twin (``nn/quantize.py``) —
+    same non-subclassing rationale as ``Conv2DGeometryMixin``."""
+
+    def _set_mha_geometry(self, num_heads, embed_dim, causal, impl,
+                          use_bias):
+        if impl not in ("flash", "blockwise", "naive"):
+            raise ValueError(f"unknown attention impl {impl!r}")
+        self.num_heads = int(num_heads)
+        self.embed_dim = embed_dim
+        self.causal = bool(causal)
+        self.impl = impl
+        self.use_bias = bool(use_bias)
+
+    def _embed(self, input_shape) -> int:
+        if len(input_shape) != 2:
+            raise ValueError(f"{self.name}: attention expects (S, E) input, "
+                             f"got {input_shape}")
+        e = input_shape[1]
+        if self.embed_dim is not None and self.embed_dim != e:
+            raise ValueError(f"{self.name}: expected embed dim "
+                             f"{self.embed_dim}, got {e}")
+        if e % self.num_heads:
+            raise ValueError(f"{self.name}: embed dim {e} not divisible by "
+                             f"{self.num_heads} heads")
+        return e
+
+    def _attend(self, q, k, v):
+        """(B, S, E) projections → heads → scaled-dot-product → (B, S, E)."""
+        b_, s, e = q.shape
+        h, dh = self.num_heads, e // self.num_heads
+
+        def heads(t):
+            return t.reshape(b_, s, h, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.impl == "naive":
+            o = attention(q, k, v, causal=self.causal)
+        elif self.impl == "blockwise":
+            o = blockwise_attention(q, k, v, causal=self.causal)
+        else:
+            o = flash_attention(q, k, v, causal=self.causal)
+        return o.transpose(0, 2, 1, 3).reshape(b_, s, e)
+
+    def output_shape(self, input_shape):
+        return input_shape
+
+    def forward_complexity(self, input_shape):
+        s, e = input_shape
+        return 4 * 2 * s * e * e + 2 * 2 * s * s * e  # projections + scores·v
+
+    def param_count(self, input_shape):
+        e = input_shape[1]
+        return 4 * e * e + (4 * e if self.use_bias else 0)
+
+    def get_config(self):
+        return {"type": self.type_name, "name": self.name,
+                "num_heads": self.num_heads, "embed_dim": self.embed_dim,
+                "causal": self.causal, "impl": self.impl,
+                "use_bias": self.use_bias}
+
+
 @register_layer("multi_head_attention")
-class MultiHeadAttentionLayer(ParameterizedLayer):
+class MultiHeadAttentionLayer(MHAGeometryMixin, ParameterizedLayer):
     """Self-attention: qkv projections → scaled-dot-product → out projection.
 
     ``impl``: ``"flash"`` (Pallas kernel, default), ``"blockwise"``
@@ -34,26 +97,11 @@ class MultiHeadAttentionLayer(ParameterizedLayer):
                  causal: bool = False, impl: str = "flash",
                  use_bias: bool = True, name: Optional[str] = None):
         super().__init__(name)
-        if impl not in ("flash", "blockwise", "naive"):
-            raise ValueError(f"unknown attention impl {impl!r}")
-        self.num_heads = int(num_heads)
-        self.embed_dim = embed_dim
-        self.causal = bool(causal)
-        self.impl = impl
-        self.use_bias = bool(use_bias)
+        self._set_mha_geometry(num_heads, embed_dim, causal, impl, use_bias)
 
     def init(self, key, input_shape):
-        if len(input_shape) != 2:
-            raise ValueError(f"{self.name}: attention expects (S, E) input, "
-                             f"got {input_shape}")
-        e = input_shape[1]
-        if self.embed_dim is not None and self.embed_dim != e:
-            raise ValueError(f"{self.name}: expected embed dim "
-                             f"{self.embed_dim}, got {e}")
+        e = self._embed(input_shape)
         self.embed_dim = e
-        if e % self.num_heads:
-            raise ValueError(f"{self.name}: embed dim {e} not divisible by "
-                             f"{self.num_heads} heads")
         keys = jax.random.split(key, 8)
         def lin(i, shape, fan_in):
             return init.kaiming_uniform(keys[i], shape, fan_in)
@@ -72,39 +120,15 @@ class MultiHeadAttentionLayer(ParameterizedLayer):
         y = jnp.matmul(x, w, precision=get_precision())
         return y + b if b is not None else y
 
-    def apply(self, params, state, x, *, training=False, rng=None):
-        b_, s, e = x.shape
-        h, dh = self.num_heads, e // self.num_heads
+    def _qkv(self, params, x):
+        """The three input projections (B, S, E) — also the calibration
+        surface for the PTQ twin, which needs the attention-core input."""
         get = params.get
-        q = self._project(x, params["wq"], get("bq"))
-        k = self._project(x, params["wk"], get("bk"))
-        v = self._project(x, params["wv"], get("bv"))
-        # (B, S, E) -> (B, H, S, Dh)
-        def heads(t):
-            return t.reshape(b_, s, h, dh).transpose(0, 2, 1, 3)
-        q, k, v = heads(q), heads(k), heads(v)
-        if self.impl == "naive":
-            o = attention(q, k, v, causal=self.causal)
-        elif self.impl == "blockwise":
-            o = blockwise_attention(q, k, v, causal=self.causal)
-        else:
-            o = flash_attention(q, k, v, causal=self.causal)
-        o = o.transpose(0, 2, 1, 3).reshape(b_, s, e)
-        return self._project(o, params["wo"], get("bo")), state
+        return (self._project(x, params["wq"], get("bq")),
+                self._project(x, params["wk"], get("bk")),
+                self._project(x, params["wv"], get("bv")))
 
-    def output_shape(self, input_shape):
-        return input_shape
-
-    def forward_complexity(self, input_shape):
-        s, e = input_shape
-        return 4 * 2 * s * e * e + 2 * 2 * s * s * e  # projections + scores·v
-
-    def param_count(self, input_shape):
-        e = input_shape[1]
-        return 4 * e * e + (4 * e if self.use_bias else 0)
-
-    def get_config(self):
-        return {"type": self.type_name, "name": self.name,
-                "num_heads": self.num_heads, "embed_dim": self.embed_dim,
-                "causal": self.causal, "impl": self.impl,
-                "use_bias": self.use_bias}
+    def apply(self, params, state, x, *, training=False, rng=None):
+        q, k, v = self._qkv(params, x)
+        o = self._attend(q, k, v)
+        return self._project(o, params["wo"], params.get("bo")), state

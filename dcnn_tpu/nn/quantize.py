@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from ..ops import quant as quant_ops
 from ..ops.conv import conv2d_int8
+from .attention_layer import MHAGeometryMixin, MultiHeadAttentionLayer
 from .factory import layer_from_config, register_layer
 from .layer import ParameterizedLayer
 from .layers import (Conv2DGeometryMixin, Conv2DLayer, DenseGeometryMixin,
@@ -132,6 +133,80 @@ class QuantDenseLayer(DenseGeometryMixin, _QuantizedLayer):
                              channel_axis=y.ndim - 1), state
 
 
+@register_layer("quant_multi_head_attention")
+class QuantMultiHeadAttentionLayer(MHAGeometryMixin, _QuantizedLayer):
+    """int8 PTQ twin of ``MultiHeadAttentionLayer``: the four (E, E)
+    projections run w8a8 on the MXU int8 path; the attention core itself
+    (scores softmax · V) stays float — at classifier lengths the
+    projections carry ~4E/S of the FLOPs (dominant for S ≲ 2E), and the
+    float core needs no cross-head scale algebra.
+
+    Params: per projection p ∈ {q, k, v, o}: ``wp_q`` int8 (E_out, E_in)
+    (transposed from the float layer's (in, out) storage so the shared
+    ``dense_int8`` GEMM applies), ``wp_s`` f32 (E,), optional ``bp`` f32;
+    plus ``x_scale`` (shared by q/k/v — same input tensor) and ``o_scale``
+    (the attention-core output feeding the out projection)."""
+
+    def __init__(self, num_heads: int, embed_dim: Optional[int] = None,
+                 causal: bool = False, impl: str = "flash",
+                 use_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self._set_mha_geometry(num_heads, embed_dim, causal, impl, use_bias)
+
+    def init(self, key, input_shape):
+        del key
+        e = self._embed(input_shape)
+        self.embed_dim = e
+        params = {"x_scale": jnp.ones((), jnp.float32),
+                  "o_scale": jnp.ones((), jnp.float32)}
+        for tag in "qkvo":
+            params[f"w{tag}_q"] = jnp.zeros((e, e), jnp.int8)
+            params[f"w{tag}_s"] = jnp.ones((e,), jnp.float32)
+            if self.use_bias:
+                params[f"b{tag}"] = jnp.zeros((e,), jnp.float32)
+        return params, {}
+
+    def _proj_int8(self, params, tag, x_q, s_in, out_dtype):
+        y = quant_ops.dense_int8(x_q, params[f"w{tag}_q"])
+        y = y.astype(jnp.float32) * (s_in * params[f"w{tag}_s"])
+        b = params.get(f"b{tag}")
+        if b is not None:
+            y = y + b
+        return y.astype(out_dtype)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if training:
+            raise ValueError(f"{self.name}: the PTQ graph is inference-only")
+        x_q = quant_ops.quantize_symmetric(x, params["x_scale"])
+        q, k, v = (self._proj_int8(params, t, x_q, params["x_scale"], x.dtype)
+                   for t in "qkv")
+        o = self._attend(q, k, v)
+        o_q = quant_ops.quantize_symmetric(o, params["o_scale"])
+        return (self._proj_int8(params, "o", o_q, params["o_scale"],
+                                x.dtype), state)
+
+
+def _quantize_mha(layer: MultiHeadAttentionLayer, lp, x, act_quantile):
+    """Quantize one MHA layer: per-output-channel int8 projections +
+    calibrated input/core scales (the core scale needs the float q/k/v and
+    attention run, via the float layer's own ``_qkv``/``_attend``). Also
+    returns the layer's float output so the walk advances without paying
+    the O(S²) attention core a second time."""
+    qp = {"x_scale": quant_ops.tensor_scale(x, quantile=act_quantile)}
+    for tag in "qkvo":
+        w_q, w_s = quant_ops.quantize_weight(
+            jnp.asarray(lp[f"w{tag}"]).T)  # (in, out) -> (out, in)
+        qp[f"w{tag}_q"], qp[f"w{tag}_s"] = w_q, w_s
+        if f"b{tag}" in lp:
+            qp[f"b{tag}"] = jnp.asarray(lp[f"b{tag}"], jnp.float32)
+    o = layer._attend(*layer._qkv(lp, x))
+    qp["o_scale"] = quant_ops.tensor_scale(o, quantile=act_quantile)
+    out = layer._project(o, lp["wo"], lp.get("bo"))
+    cfg = layer.get_config()
+    cfg.pop("type")
+    return QuantMultiHeadAttentionLayer(**cfg), qp, out
+
+
 def _quantize_linear(layer, lp, x, qcls, act_quantile):
     """Build the quantized twin of one conv/dense layer from its float
     params and the calibration activation feeding it."""
@@ -156,6 +231,7 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x,
     out_p: List[Any] = []
     out_s: List[Any] = []
     for layer, lp, ls in zip(layers, params, state):
+        advanced = None  # branch-supplied next activation (avoids re-apply)
         if isinstance(layer, Conv2DLayer):
             ql, qp = _quantize_linear(layer, lp, x, QuantConv2DLayer,
                                       act_quantile)
@@ -165,6 +241,11 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x,
         elif isinstance(layer, DenseLayer):
             ql, qp = _quantize_linear(layer, lp, x, QuantDenseLayer,
                                       act_quantile)
+            out_l.append(ql)
+            out_p.append(qp)
+            out_s.append({})
+        elif isinstance(layer, MultiHeadAttentionLayer):
+            ql, qp, advanced = _quantize_mha(layer, lp, x, act_quantile)
             out_l.append(ql)
             out_p.append(qp)
             out_s.append({})
@@ -181,7 +262,8 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x,
             out_l.append(layer_from_config(layer.get_config()))
             out_p.append(lp)
             out_s.append(ls)
-        x, _ = layer.apply(lp, ls, x, training=False)
+        x = (advanced if advanced is not None
+             else layer.apply(lp, ls, x, training=False)[0])
     return out_l, out_p, out_s, x
 
 

@@ -186,6 +186,44 @@ def test_quantize_without_fold():
     assert cos > 0.995
 
 
+def test_quantize_mha_classifier():
+    """PTQ covers the attention family: the zoo's mha_classifier (MHA blocks
+    inside ResidualBlocks) quantizes its projections w8a8 and tracks the
+    float model."""
+    from dcnn_tpu.models import create_mha_classifier
+    from dcnn_tpu.nn import QuantMultiHeadAttentionLayer
+
+    model = create_mha_classifier()
+    ts = _train_a_bit(model, n_steps=3, bs=8)
+    calib = jnp.asarray(np.random.default_rng(11).normal(
+        size=(16, 32, 64)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+
+    def count_qmha(layers):
+        n = 0
+        for l in layers:
+            if isinstance(l, QuantMultiHeadAttentionLayer):
+                n += 1
+            if hasattr(l, "layers") and hasattr(l, "shortcut"):
+                n += count_qmha(l.layers) + count_qmha(l.shortcut)
+        return n
+
+    assert count_qmha(qm.layers) == 2
+    # per-projection int8 weights + the two calibrated activation scales
+    mha_p = qp[0]["main"][0]
+    assert mha_p["wq_q"].dtype == jnp.int8
+    assert float(mha_p["x_scale"]) > 0 and float(mha_p["o_scale"]) > 0
+    cos, top1 = _agreement(model, ts, qm, qp, qs, bs=16)
+    assert cos > 0.98, f"logit cosine {cos}"
+
+    # zero-template init (checkpoint restoration path) + config round-trip
+    qm2 = Sequential.from_config(qm.get_config())
+    tp, _ = qm2.init(jax.random.PRNGKey(0))
+    t_mha = tp[0]["main"][0]
+    assert t_mha["wo_q"].shape == mha_p["wo_q"].shape
+    assert not np.any(np.asarray(t_mha["wo_q"]))
+
+
 def test_quantized_model_refuses_training():
     model = (SequentialBuilder(name="ro", data_format="NHWC")
              .input((6, 6, 1))
