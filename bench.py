@@ -395,12 +395,22 @@ def int8_inference_section(data_format: str):
                     jnp.float32)
     fmodel, fp, fs = fold_batchnorm(model, ts.params, ts.state)
     qmodel, qp, qs = quantize_model(model, ts.params, ts.state, x)
-    dt_f = time_chained(
+
+    # roofline sanity gate lives in the shared harness (time_chained
+    # roofline=): retry on physically impossible implied FLOP rates, and
+    # return None rather than let an impossible number into the driver
+    # capture if it persists
+    fwd_flops = float(model.forward_complexity()) * batch
+    bf16_peak = 197e12 if on_tpu else None
+    dt_f, f_sane = time_chained(
         lambda c: fmodel.apply(fp, fs, c, training=False)[0], (x,),
-        dep_feed(0), length=length)
-    dt_q = time_chained(
+        dep_feed(0), length=length, roofline=(fwd_flops, bf16_peak))
+    dt_q, q_sane = time_chained(
         lambda c: qmodel.apply(qp, qs, c, training=False)[0], (x,),
-        dep_feed(0), length=length)
+        dep_feed(0), length=length,
+        roofline=(fwd_flops, bf16_peak * 2 if bf16_peak else None))
+    if not (f_sane and q_sane):
+        return None
     return batch / dt_f, batch / dt_q
 
 
@@ -501,10 +511,16 @@ def main() -> None:
     # deployment-graph inference: BN-folded bf16 vs int8 PTQ (default-on so
     # the driver capture carries the number; BENCH_INT8=0 opts out)
     if os.environ.get("BENCH_INT8", "1") == "1":
-        bf16_ips, int8_ips = int8_inference_section(data_format)
-        out["infer_bf16_img_per_sec"] = round(bf16_ips, 1)
-        out["infer_int8_img_per_sec"] = round(int8_ips, 1)
-        out["int8_speedup_x"] = round(int8_ips / bf16_ips, 3)
+        res = int8_inference_section(data_format)
+        if res is None:  # roofline gate refused (see int8_inference_section)
+            out["infer_bf16_img_per_sec"] = None
+            out["infer_int8_img_per_sec"] = None
+            out["int8_speedup_x"] = None
+        else:
+            bf16_ips, int8_ips = res
+            out["infer_bf16_img_per_sec"] = round(bf16_ips, 1)
+            out["infer_int8_img_per_sec"] = round(int8_ips, 1)
+            out["int8_speedup_x"] = round(int8_ips / bf16_ips, 3)
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision

@@ -143,17 +143,83 @@ def _model_end_to_end(results, rng, length):
         top1 = float(np.mean(y_f.argmax(-1) == y_q.argmax(-1)))
         ok = cos > 0.95 and top1 >= 0.85
 
-        dt_f = time_chained(fwd_f, (xf,), dep_feed(0), length=length)
-        dt_q = time_chained(fwd_q, (xf,), dep_feed(0), length=length)
+        # Roofline sanity gate (time_chained roofline= — see common.py): a
+        # capture of this section once measured an implied 232 TF/s bf16
+        # forward, above the 197 TF/s v5e peak. int8 peak is 2x bf16.
+        fwd_flops = float(model.forward_complexity()) * batch
+        bf16_peak = 197e12 if jax.default_backend() == "tpu" else None
+        dt_f, f_sane = time_chained(
+            fwd_f, (xf,), dep_feed(0), length=length,
+            roofline=(fwd_flops, bf16_peak))
+        dt_q, q_sane = time_chained(
+            fwd_q, (xf,), dep_feed(0), length=length,
+            roofline=(fwd_flops, bf16_peak * 2 if bf16_peak else None))
     finally:
         set_precision("parity")
     net = "mnist_cnn" if tiny_mode() else "resnet18"
     results.append(Result(f"{net}_infer_bf16_folded", dt_f, batch / dt_f,
-                          "img/s", True, 0.0))
+                          "img/s", f_sane, 0.0))
     results.append(Result(f"{net}_infer_int8_ptq", dt_q, batch / dt_q,
-                          "img/s", ok, 1.0 - cos))
+                          "img/s", ok and q_sane, 1.0 - cos))
     results.append(Result(f"{net}_int8_speedup", dt_q, dt_f / dt_q,
-                          "x_vs_bf16", ok, 1.0 - top1))
+                          "x_vs_bf16", ok and f_sane and q_sane, 1.0 - top1))
+
+
+def _mha_end_to_end(results, rng, length):
+    """Attention-family PTQ: the zoo mha_classifier's projections w8a8
+    (QuantMultiHeadAttentionLayer), float attention core — vs the float
+    model at the production inference precision."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.core.precision import set_precision
+    from dcnn_tpu.models import create_mha_classifier
+    from dcnn_tpu.nn import quantize_model
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train.trainer import create_train_state, make_train_step
+
+    model = create_mha_classifier()
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(2))
+    step = make_train_step(model, softmax_cross_entropy, opt, donate=False)
+    bs_train = 8
+    for i in range(2 if tiny_mode() else 4):
+        x = jnp.asarray(rng.normal(size=(bs_train, 32, 64)), jnp.float32)
+        y = jnp.asarray(np.eye(10, dtype=np.float32)[
+            rng.integers(0, 10, size=bs_train)])
+        ts, _, _ = step(ts, x, y, jax.random.fold_in(jax.random.PRNGKey(4), i),
+                        1e-3)
+
+    batch = 32 if tiny_mode() else 1024
+    xf = jnp.asarray(rng.normal(size=(batch, 32, 64)), jnp.float32)
+    qmodel, qp, qs = quantize_model(model, ts.params, ts.state, xf)
+
+    on_tpu = jax.default_backend() == "tpu"
+    set_precision("bf16" if on_tpu else "fast")
+    try:
+        fwd_f = jax.jit(lambda xx: model.apply(
+            ts.params, ts.state, xx, training=False)[0])
+        fwd_q = jax.jit(lambda xx: qmodel.apply(qp, qs, xx,
+                                                training=False)[0])
+        y_f = np.asarray(fwd_f(xf), np.float64)
+        y_q = np.asarray(fwd_q(xf), np.float64)
+        cos = float((y_f.ravel() @ y_q.ravel())
+                    / (np.linalg.norm(y_f) * np.linalg.norm(y_q) + 1e-12))
+        ok = cos > 0.95
+        fwd_flops = float(model.forward_complexity()) * batch
+        bf16_peak = 197e12 if jax.default_backend() == "tpu" else None
+        dt_f, f_sane = time_chained(fwd_f, (xf,), dep_feed(0), length=length,
+                                    roofline=(fwd_flops, bf16_peak))
+        dt_q, q_sane = time_chained(
+            fwd_q, (xf,), dep_feed(0), length=length,
+            roofline=(fwd_flops, bf16_peak * 2 if bf16_peak else None))
+    finally:
+        set_precision("parity")
+    results.append(Result("mha_infer_float", dt_f, batch / dt_f,
+                          "seq/s", f_sane, 0.0))
+    results.append(Result("mha_infer_int8_ptq", dt_q, batch / dt_q,
+                          "seq/s", ok and q_sane, 1.0 - cos))
 
 
 def run() -> dict:
@@ -163,6 +229,7 @@ def run() -> dict:
     results = []
     _conv_micro(results, rng, batch, length)
     _model_end_to_end(results, rng, length)
+    _mha_end_to_end(results, rng, length)
     return report("int8", results, meta={"batch": batch})
 
 

@@ -95,9 +95,19 @@ def time_callable(fn: Callable[[], Any], steps: int = 10, reps: int = 3,
 
 
 def time_chained(op: Callable, args: tuple, feed: Callable,
-                 length: int = 32, reps: int = 5) -> float:
+                 length: int = 32, reps: int = 5, roofline=None):
     """Per-iteration seconds for ``length`` data-dependent iterations of
     ``op`` inside ONE jitted dispatch (``lax.scan``).
+
+    ``roofline=(flops_per_iteration, peak_flops_or_None)``: physical sanity
+    gate. One capture of a short inference chain measured an implied 232
+    TF/s bf16 forward — above the 197 TF/s v5e peak, i.e. impossible: the
+    two-length delta occasionally lands on correlated tunnel jitter. With
+    ``roofline`` set the measurement is retried up to twice while the
+    implied FLOP rate exceeds 1.05× peak, and the return becomes a tuple
+    ``(seconds, sane)`` so callers can flag (never silently report) a
+    persistently impossible number. ``peak=None`` skips the check but keeps
+    the tuple shape.
 
     On tunnelled/remote PJRT backends a single dispatch costs ~10 ms wall
     regardless of the op, so ``time_callable`` measures the tunnel, not the
@@ -125,10 +135,21 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     import jax.numpy as jnp
     from jax import lax
 
+    def _gated(measure):
+        dt = measure()
+        if roofline is None:
+            return dt
+        flops, peak = roofline
+        tries = 0
+        while peak and flops / dt > 1.05 * peak and tries < 2:
+            dt = measure()
+            tries += 1
+        return dt, not (peak and flops / dt > 1.05 * peak)
+
     if jax.default_backend() == "cpu":
         jfn = jax.jit(lambda a: op(*a))
-        return time_callable(lambda: jfn(args), steps=min(length, 10),
-                             reps=reps)
+        return _gated(lambda: time_callable(
+            lambda: jfn(args), steps=min(length, 10), reps=reps))
 
     @jax.jit
     def run(a, n):
@@ -176,36 +197,44 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     MAX_RUN_WALL = 8.0           # never schedule a device loop much past
                                  # this — long single kernels can trip the
                                  # TPU watchdog and kill the worker process
-    while True:
-        short = max(1, length // 4)
-        t_longs, diffs = [], []
-        for _ in range(reps):
-            tl = one(length)
-            diffs.append(tl - one(short))
-            t_longs.append(tl)
-        diffs.sort()
-        delta = diffs[len(diffs) // 2]
-        t_long = sorted(t_longs)[len(t_longs) // 2]
-        if delta >= NOISE_FLOOR or length >= MAX_LENGTH or t_long >= MAX_RUN_WALL:
-            break
+
+    def measure() -> float:
+        nonlocal length
+        while True:
+            short = max(1, length // 4)
+            t_longs, diffs = [], []
+            for _ in range(reps):
+                tl = one(length)
+                diffs.append(tl - one(short))
+                t_longs.append(tl)
+            diffs.sort()
+            delta = diffs[len(diffs) // 2]
+            t_long = sorted(t_longs)[len(t_longs) // 2]
+            if (delta >= NOISE_FLOOR or length >= MAX_LENGTH
+                    or t_long >= MAX_RUN_WALL):
+                break
+            if delta > 0:
+                # scale so the next delta lands ~2x the floor, bounded by
+                # the per-run wall guard (measured t_long is the ground
+                # truth for how expensive this loop really is)
+                est = delta / (length - short)
+                target = max(length * 2, int(2 * NOISE_FLOOR / est * 1.34))
+                wall_cap = max(length * 2,
+                               int(length * MAX_RUN_WALL / max(t_long, 1e-3)))
+                length = min(MAX_LENGTH, target, wall_cap)
+            else:
+                # delta lost in jitter: escalate gently — a huge jump here
+                # (est~0 => max length) once produced a
+                # quarter-million-iteration kernel that crashed the TPU
+                # worker
+                length = min(MAX_LENGTH, length * 4)
         if delta > 0:
-            # scale so the next delta lands ~2x the floor, bounded by the
-            # per-run wall guard (measured t_long is the ground truth for
-            # how expensive this loop really is)
-            est = delta / (length - short)
-            target = max(length * 2, int(2 * NOISE_FLOOR / est * 1.34))
-            wall_cap = max(length * 2, int(length * MAX_RUN_WALL / max(t_long, 1e-3)))
-            length = min(MAX_LENGTH, target, wall_cap)
-        else:
-            # delta lost in jitter: escalate gently — a huge jump here
-            # (est~0 => max length) once produced a quarter-million-iteration
-            # kernel that crashed the TPU worker
-            length = min(MAX_LENGTH, length * 4)
-    if delta > 0:
-        return delta / (length - short)
-    # degenerate (op so cheap it drowns in jitter even at MAX_LENGTH):
-    # fall back to the long-run average, which at worst over-reports
-    return one(length) / length
+            return delta / (length - short)
+        # degenerate (op so cheap it drowns in jitter even at MAX_LENGTH):
+        # fall back to the long-run average, which at worst over-reports
+        return one(length) / length
+
+    return _gated(measure)
 
 
 def replace_feed(i: int = 0):
