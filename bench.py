@@ -374,7 +374,7 @@ def int8_inference_section(data_format: str):
 
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
-    from common import dep_feed, time_chained
+    from common import dep_feed, e2e_chain_length, time_chained
 
     from dcnn_tpu.models import create_resnet18_tiny_imagenet
     from dcnn_tpu.nn import fold_batchnorm, quantize_model
@@ -386,7 +386,7 @@ def int8_inference_section(data_format: str):
     on_tpu = jax.default_backend() == "tpu"
     batch = int(os.environ.get("BENCH_INT8_BATCH",
                                "256" if on_tpu else "8"))
-    length = 256 if on_tpu else 8
+    length = e2e_chain_length(8)  # jitter rationale: benchmarks/common.py
     model = create_resnet18_tiny_imagenet(data_format)
     ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(3))
     shape = ((batch, 3, 64, 64) if data_format == "NCHW"

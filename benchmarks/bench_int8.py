@@ -20,7 +20,8 @@ import sys
 
 import numpy as np
 
-from common import Result, dep_feed, print_table, report, time_chained, tiny_mode
+from common import (Result, dep_feed, e2e_chain_length, print_table, report,
+                    time_chained, tiny_mode)
 
 # (cin, cout, hw) 3×3 s1 p1 ResNet-18 body shapes (the stem is
 # channel-starved in any dtype; the body is where the MXU time goes)
@@ -131,7 +132,8 @@ def _model_end_to_end(results, rng, length):
 
     # production inference precision is bf16 mixed; on the CPU smoke path
     # bf16 is emulated (and glacial), so the float twin stays in fast-f32
-    set_precision("bf16" if jax.default_backend() == "tpu" else "fast")
+    on_tpu = jax.default_backend() == "tpu"
+    set_precision("bf16" if on_tpu else "fast")
     try:
         fwd_f = jax.jit(fwd_f_impl)
         fwd_q = jax.jit(fwd_q_impl)
@@ -146,13 +148,15 @@ def _model_end_to_end(results, rng, length):
         # Roofline sanity gate (time_chained roofline= — see common.py): a
         # capture of this section once measured an implied 232 TF/s bf16
         # forward, above the 197 TF/s v5e peak. int8 peak is 2x bf16.
+        # Chain length: common.e2e_chain_length (jitter rationale there).
         fwd_flops = float(model.forward_complexity()) * batch
-        bf16_peak = 197e12 if jax.default_backend() == "tpu" else None
+        e2e_len = e2e_chain_length(length)
+        bf16_peak = 197e12 if on_tpu else None
         dt_f, f_sane = time_chained(
-            fwd_f, (xf,), dep_feed(0), length=length,
+            fwd_f, (xf,), dep_feed(0), length=e2e_len,
             roofline=(fwd_flops, bf16_peak))
         dt_q, q_sane = time_chained(
-            fwd_q, (xf,), dep_feed(0), length=length,
+            fwd_q, (xf,), dep_feed(0), length=e2e_len,
             roofline=(fwd_flops, bf16_peak * 2 if bf16_peak else None))
     finally:
         set_precision("parity")
@@ -208,11 +212,12 @@ def _mha_end_to_end(results, rng, length):
                     / (np.linalg.norm(y_f) * np.linalg.norm(y_q) + 1e-12))
         ok = cos > 0.95
         fwd_flops = float(model.forward_complexity()) * batch
-        bf16_peak = 197e12 if jax.default_backend() == "tpu" else None
-        dt_f, f_sane = time_chained(fwd_f, (xf,), dep_feed(0), length=length,
+        e2e_len = e2e_chain_length(length)
+        bf16_peak = 197e12 if on_tpu else None
+        dt_f, f_sane = time_chained(fwd_f, (xf,), dep_feed(0), length=e2e_len,
                                     roofline=(fwd_flops, bf16_peak))
         dt_q, q_sane = time_chained(
-            fwd_q, (xf,), dep_feed(0), length=length,
+            fwd_q, (xf,), dep_feed(0), length=e2e_len,
             roofline=(fwd_flops, bf16_peak * 2 if bf16_peak else None))
     finally:
         set_precision("parity")

@@ -237,6 +237,22 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     return _gated(measure)
 
 
+def e2e_chain_length(short_length: int) -> int:
+    """Chain length for end-to-end model rows (both bench entry points).
+
+    On TPU, 1024 iterations put ~1-2 s of device work behind the two-length
+    delta: at the default-escalated ~100 ms delta the tunnel's ±10-20 ms
+    correlated jitter was a ±10-20% multiplier on these rows (observed int8
+    e2e spread 203-264k img/s; ±0.4% after this change). Tiny mode and CPU
+    keep the caller's short length — the CPU fallback is per-dispatch
+    timing and tiny mode must stay CI-sized on any backend."""
+    import jax
+
+    if tiny_mode() or jax.default_backend() != "tpu":
+        return short_length
+    return 1024
+
+
 def replace_feed(i: int = 0):
     """Feed for time_chained when the op output has the same shape/dtype as
     ``args[i]``: the output simply becomes the next iteration's input. Full

@@ -137,9 +137,9 @@ class QuantDenseLayer(DenseGeometryMixin, _QuantizedLayer):
 class QuantMultiHeadAttentionLayer(MHAGeometryMixin, _QuantizedLayer):
     """int8 PTQ twin of ``MultiHeadAttentionLayer``: the four (E, E)
     projections run w8a8 on the MXU int8 path; the attention core itself
-    (scores softmax · V) stays float — at classifier lengths the
-    projections carry ~4E/S of the FLOPs (dominant for S ≲ 2E), and the
-    float core needs no cross-head scale algebra.
+    (scores softmax · V) stays float — the projection/core FLOP ratio is
+    ~2E/S, so projections dominate for S ≲ 2E (every zoo classifier), and
+    the float core needs no cross-head scale algebra.
 
     Params: per projection p ∈ {q, k, v, o}: ``wp_q`` int8 (E_out, E_in)
     (transposed from the float layer's (in, out) storage so the shared

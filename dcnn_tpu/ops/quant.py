@@ -4,11 +4,11 @@ Beyond the reference: the reference has no quantized path (its inference is
 the training graph minus update). On TPU v5e the MXU's int8 mode doubles the
 bf16 peak (~394 TOP/s vs ~197 TFLOP/s), and XLA lowers int8
 ``conv_general_dilated`` / ``dot_general`` with ``preferred_element_type=
-int32`` straight onto it — roughly parity-to-+14% per compute-bound kernel
-on chained ResNet-body convs, and 1.62-1.89× end-to-end on ResNet-18
-inference where the bandwidth-bound layers also gain from halved operand
-bytes (``benchmarks/bench_int8.py``, RESULTS.md "int8 PTQ inference" for
-the artifact numbers and the measurement caveats). These kernels are the compute half of
+int32`` straight onto it — roughly parity-to-+13% per compute-bound kernel
+on chained ResNet-body convs, and 1.62× end-to-end on ResNet-18 inference
+where the bandwidth-bound layers also gain from halved operand bytes
+(``benchmarks/bench_int8.py``, RESULTS.md "int8 PTQ inference" for the
+artifact numbers and the measurement-spread postmortem). These kernels are the compute half of
 ``nn.quantize_model`` (post-training quantization of the folded inference
 graph).
 
