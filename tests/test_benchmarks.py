@@ -41,6 +41,58 @@ def test_serialization_section_runs_and_gates():
     json.dumps(doc)
 
 
+def test_time_chained_roofline_gate(monkeypatch):
+    """The roofline= contract: without it, a scalar; with it, (seconds,
+    sane) — and an implied FLOP rate above 1.05x peak is retried then
+    flagged sane=False rather than silently returned (the guard behind the
+    int8 e2e rows; see RESULTS.md measurement-spread postmortem). The
+    backend is pinned to the CPU per-dispatch fallback so the forced-insane
+    case never chases the TPU noise-floor escalation (minutes on a real
+    chip for a trivial op)."""
+    import jax
+    import jax.numpy as jnp
+
+    from common import dep_feed, time_chained
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    x = jnp.ones((8, 8), jnp.float32)
+    op = lambda a: a * 2.0
+
+    dt = time_chained(op, (x,), dep_feed(0), length=4)
+    assert isinstance(dt, float) and dt > 0
+
+    # absurdly high peak -> any measurement is sane
+    dt, sane = time_chained(op, (x,), dep_feed(0), length=4,
+                            roofline=(1.0, 1e30))
+    assert sane is True and dt > 0
+    # peak=None skips the check but keeps the tuple shape
+    dt, sane = time_chained(op, (x,), dep_feed(0), length=4,
+                            roofline=(1e30, None))
+    assert sane is True
+    # absurdly low peak -> implied rate always "impossible": retried, then
+    # flagged, never silently returned as a bare float
+    dt, sane = time_chained(op, (x,), dep_feed(0), length=4,
+                            roofline=(1e30, 1.0))
+    assert sane is False and dt > 0
+
+
+def test_e2e_chain_length_contract(monkeypatch):
+    """Both branches pinned explicitly (the real backend varies by host):
+    TPU gets the long jitter-proof chain unless tiny mode; CPU keeps the
+    caller's short length always."""
+    import jax
+
+    from common import e2e_chain_length
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert e2e_chain_length(8) == 8
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert e2e_chain_length(8) == 1024
+    monkeypatch.setenv("BENCH_TINY", "1")
+    assert e2e_chain_length(4) == 4
+
+
 @pytest.mark.slow
 def test_run_all_tiny_subprocess():
     """Full suite in tiny mode as one command (the 'one command emits a
