@@ -26,6 +26,7 @@ from .builder import SequentialBuilder
 from .fold import fold_batchnorm
 from .quantize import (QuantConv2DLayer, QuantDenseLayer,
                        QuantMultiHeadAttentionLayer, quantize_model)
+from .export import export_inference, load_inference
 
 __all__ = [
     "Layer", "ParameterizedLayer", "StatelessLayer",
@@ -37,4 +38,5 @@ __all__ = [
     "fold_batchnorm",
     "QuantConv2DLayer", "QuantDenseLayer", "QuantMultiHeadAttentionLayer",
     "quantize_model",
+    "export_inference", "load_inference",
 ]

@@ -1,0 +1,75 @@
+"""StableHLO export of the inference graph — the portable deployment
+artifact.
+
+Beyond the reference (its deployment story ends at binary weight files that
+only its own C++ runtime can read, ``sequential.hpp:832-915``): here the
+whole inference *computation* — after ``fold_batchnorm`` and optionally
+``quantize_model`` — serializes to a self-contained StableHLO artifact via
+``jax.export``. The artifact embeds the weights as constants and can be
+reloaded and executed by any JAX process (or any StableHLO-consuming
+runtime) without the model class, the layer registry, or this package's
+code: the checkpoint format ships *state*, the artifact ships the *program*.
+
+Batch-polymorphic by default: the batch dimension exports as a symbolic
+size, so one artifact serves any batch. The compile happens at load/call
+time for the concrete shapes, exactly like a jitted function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from .sequential import Sequential
+
+
+def export_inference(model: Sequential, params, state, *,
+                     batch_size: Optional[int] = None,
+                     input_dtype: Any = jnp.float32,
+                     platforms: Tuple[str, ...] = ("cpu", "tpu")) -> bytes:
+    """Serialize ``model``'s eval-mode forward (weights baked in) to a
+    StableHLO artifact.
+
+    ``model`` is exported AS GIVEN — run :func:`~dcnn_tpu.nn.fold.
+    fold_batchnorm` and/or :func:`~dcnn_tpu.nn.quantize.quantize_model`
+    first; those transforms are deliberate deployment decisions, not
+    defaults this function should hide.
+
+    ``batch_size=None`` (default) exports a batch-polymorphic artifact
+    (symbolic leading dimension); pass an int to pin it (slightly better
+    XLA specialization, one shape only).
+
+    ``platforms`` defaults to ``("cpu", "tpu")`` so the artifact actually
+    honors the portability claim — ``jax.export`` otherwise pins lowering
+    to the exporting process's backend and the artifact refuses to run
+    anywhere else. Note the trace still happens once on the exporting
+    backend, so backend-dispatched impl choices (e.g. the flash-attention
+    TPU kernel vs its blockwise fallback) are baked at export time; models
+    whose traced ops are TPU-only must pass ``platforms=("tpu",)``.
+    """
+    if model.input_shape is None:
+        raise ValueError("model has no input_shape; build it through "
+                         "SequentialBuilder.input or set input_shape")
+
+    def fwd(x):
+        return model.apply(params, state, x, training=False)[0]
+
+    if batch_size is None:
+        b, = jax_export.symbolic_shape("b")
+    else:
+        b = int(batch_size)
+    spec = jax.ShapeDtypeStruct((b, *model.input_shape), input_dtype)
+    # serialize() hands back a bytearray; normalize to immutable bytes
+    return bytes(jax_export.export(
+        jax.jit(fwd), platforms=tuple(platforms))(spec).serialize())
+
+
+def load_inference(blob: bytes) -> Callable:
+    """Reload a serialized artifact as a callable ``f(x) -> logits``.
+
+    Needs only JAX — no model class, layer registry, or checkpoint; the
+    weights live inside the artifact as constants."""
+    return jax_export.deserialize(blob).call

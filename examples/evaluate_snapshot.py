@@ -123,6 +123,29 @@ def main():
     if float(acc) - float(qacc) > 0.005:
         raise SystemExit(f"int8 quantization dropped accuracy: {acc} -> {qacc}")
 
+    # EXPORT=1: serialize the folded and int8 graphs to self-contained
+    # StableHLO artifacts (nn.export_inference — weights baked in; reload
+    # needs only JAX, not this package) and verify the reloaded artifact
+    # reproduces the live model's predictions on a real batch
+    if os.environ.get("EXPORT", "0") == "1":
+        from dcnn_tpu.nn import export_inference, load_inference
+
+        out_dir = os.environ.get("EXPORT_DIR", "/tmp/dcnn_export")
+        os.makedirs(out_dir, exist_ok=True)
+        xb = calib[:64]
+        for tag, (m, p, s) in (("folded", (fmodel, fparams, fstate)),
+                               ("int8", (qmodel, qparams, qstate))):
+            blob = export_inference(m, p, s)
+            path = os.path.join(out_dir, f"{model.name}_{tag}.stablehlo")
+            with open(path, "wb") as f:
+                f.write(blob)
+            live = np.asarray(m.apply(p, s, xb, training=False)[0])
+            art = np.asarray(load_inference(blob)(xb))
+            if not np.array_equal(art.argmax(-1), live.argmax(-1)):
+                raise SystemExit(f"{tag} artifact diverged from live model")
+            print(f"exported {tag}: {path} ({len(blob):,} bytes, "
+                  "artifact == live on a real batch)")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,107 @@
+"""StableHLO export tests (nn/export.py).
+
+Beyond-reference deployment capability: the folded / quantized inference
+graph serializes to a self-contained artifact that reloads and runs with
+only JAX — no model class or checkpoint. Contracts: output identity vs the
+live model, batch polymorphism, int8-graph export, and the artifact's
+independence from the source objects (mutating them after export must not
+change the artifact's outputs).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dcnn_tpu.nn import (
+    SequentialBuilder, export_inference, fold_batchnorm, load_inference,
+    quantize_model,
+)
+
+from test_fold import _train_a_bit
+
+
+def _small_model():
+    return (SequentialBuilder(name="exp", data_format="NHWC")
+            .input((8, 8, 3))
+            .conv2d(8, 3, padding=1).batchnorm().activation("relu")
+            .maxpool2d(2).flatten().dense(10)
+            .build())
+
+
+def test_export_roundtrip_matches_live_model():
+    model = _small_model()
+    ts = _train_a_bit(model)
+    fmodel, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+    blob = export_inference(fmodel, fp, fs)
+    assert isinstance(blob, bytes) and len(blob) > 0
+
+    f = load_inference(blob)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 8, 8, 3)).astype(np.float32))
+    want, _ = fmodel.apply(fp, fs, x, training=False)
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_export_batch_polymorphic():
+    model = _small_model()
+    ts = _train_a_bit(model)
+    blob = export_inference(model, ts.params, ts.state)
+    f = load_inference(blob)
+    rng = np.random.default_rng(1)
+    for b in (1, 3, 16):
+        y = f(jnp.asarray(rng.normal(size=(b, 8, 8, 3)).astype(np.float32)))
+        assert y.shape == (b, 10)
+
+
+def test_export_pinned_batch_rejects_other_batches():
+    model = _small_model()
+    ts = _train_a_bit(model)
+    blob = export_inference(model, ts.params, ts.state, batch_size=4)
+    f = load_inference(blob)
+    assert f(jnp.zeros((4, 8, 8, 3), jnp.float32)).shape == (4, 10)
+    with pytest.raises(Exception):
+        f(jnp.zeros((2, 8, 8, 3), jnp.float32))
+
+
+def test_export_quantized_graph():
+    model = _small_model()
+    ts = _train_a_bit(model)
+    calib = jnp.asarray(np.random.default_rng(2).normal(
+        size=(16, 8, 8, 3)).astype(np.float32))
+    qmodel, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+    blob = export_inference(qmodel, qp, qs)
+    f = load_inference(blob)
+    x = calib[:4]
+    want, _ = qmodel.apply(qp, qs, x, training=False)
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_export_is_self_contained():
+    """Only the blob (plus JAX) is needed: the live logits computed BEFORE
+    export must be reproduced after every source object (model, params,
+    state) is deleted and collected — the artifact carries the weights."""
+    import gc
+
+    model = _small_model()
+    ts = _train_a_bit(model)
+    x = jnp.asarray(np.random.default_rng(3).normal(
+        size=(2, 8, 8, 3)).astype(np.float32))
+    want = np.asarray(model.apply(ts.params, ts.state, x,
+                                  training=False)[0])
+    blob = export_inference(model, ts.params, ts.state)
+    del model, ts
+    gc.collect()
+    got = np.asarray(load_inference(blob)(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert np.abs(want).sum() > 0  # the baked weights are the trained ones
+
+
+def test_export_requires_input_shape():
+    from dcnn_tpu.nn import Sequential
+
+    with pytest.raises(ValueError, match="input_shape"):
+        export_inference(Sequential([], name="noshape"), (), ())
