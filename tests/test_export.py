@@ -100,6 +100,25 @@ def test_export_is_self_contained():
     assert np.abs(want).sum() > 0  # the baked weights are the trained ones
 
 
+def test_export_mha_model():
+    """The attention family exports too. Backend-dispatched impl choices
+    bake at trace time: on the CPU test backend the flash layer traces its
+    blockwise fallback, which is platform-neutral — so the artifact stays
+    portable. (A TPU-side export of the Pallas kernel needs
+    platforms=("tpu",); see the export_inference docstring.)"""
+    from dcnn_tpu.models import create_mha_classifier
+
+    model = create_mha_classifier()
+    ts = _train_a_bit(model, n_steps=2, bs=8)
+    blob = export_inference(model, ts.params, ts.state)
+    f = load_inference(blob)
+    x = jnp.asarray(np.random.default_rng(5).normal(
+        size=(4, 32, 64)).astype(np.float32))
+    want, _ = model.apply(ts.params, ts.state, x, training=False)
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_export_requires_input_shape():
     from dcnn_tpu.nn import Sequential
 
