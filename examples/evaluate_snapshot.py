@@ -11,6 +11,10 @@ fold's correctness is proven end-to-end on real data, not just in unit tests.
 Usage:
     python examples/evaluate_snapshot.py [snapshot_dir] [test_csv]
 
+``EXPORT=1`` additionally serializes the folded and int8 graphs to
+self-contained StableHLO artifacts (``EXPORT_DIR``, default
+``/tmp/dcnn_export``) and verifies each against the live model.
+
 Defaults: ``model_snapshots/mnist_cnn_model`` (committed — a digits28
 best-val checkpoint from the parity run) and ``data/digits28/test.csv``
 (regenerated deterministically if absent).
