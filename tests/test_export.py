@@ -119,6 +119,41 @@ def test_export_mha_model():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_committed_artifacts_hit_committed_accuracy():
+    """The deployable unit of record: the StableHLO artifacts committed
+    next to the digits28 snapshot must reproduce its accuracy on the real
+    test split using ONLY jax + the blob — no model class, registry, or
+    checkpoint machinery. (Reference analog: mnist_cnn_test.cpp evaluates
+    a saved snapshot; here the saved *program* is what evaluates.)"""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snap = os.path.join(repo, "model_snapshots", "mnist_cnn_model")
+    sys.path.insert(0, os.path.join(repo, "examples"))
+    import accuracy_gates
+
+    from dcnn_tpu.data import MNISTDataLoader
+
+    csv = os.path.join(accuracy_gates.ensure_digits28_csvs(), "test.csv")
+    val = MNISTDataLoader(csv, data_format="NCHW", batch_size=512,
+                          shuffle=False, drop_last=False)
+    val.load_data()
+    xs, ys = [], []
+    for xb, yb in val:
+        xs.append(np.asarray(xb))
+        ys.append(np.asarray(yb))
+    x = jnp.asarray(np.concatenate(xs))
+    y = np.concatenate(ys).argmax(-1)
+
+    for tag in ("folded", "int8"):
+        path = os.path.join(snap, f"mnist_cnn_model_{tag}.stablehlo")
+        with open(path, "rb") as f:
+            logits = load_inference(f.read())(x)
+        acc = float(np.mean(np.asarray(logits).argmax(-1) == y))
+        assert acc >= 0.99, f"{tag} artifact top-1 {acc}"
+
+
 def test_export_requires_input_shape():
     from dcnn_tpu.nn import Sequential
 
