@@ -1,0 +1,58 @@
+"""The bundled offline real-image dataset: sklearn digits upsampled to
+MNIST geometry.
+
+This build environment has zero egress, so the accuracy gates, the
+cross-framework parity runbook, the eval-only driver, and the
+committed-artifact tests all need a REAL image dataset that regenerates
+deterministically on any host. sklearn's bundled 8×8 digits, bilinearly
+upsampled to 28×28 and written as MNIST-format CSVs (seeded 80/20 split),
+is that dataset — it exercises the exact 28×28 loader/BN/augment pipeline
+the MNIST gate would.
+
+Lives in the package (not ``examples/``) because multiple consumers across
+examples/ and tests/ need it without sys.path games; ``examples/
+accuracy_gates.ensure_digits28_csvs`` delegates here.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def ensure_digits28_csvs(root: str) -> str:
+    """Generate ``<root>/data/digits28/{train,test}.csv`` if absent; returns
+    the dataset dir. Cheap and deterministic — a gitignored ``data/``
+    regenerates identically on any host."""
+    from scipy import ndimage
+    from sklearn.datasets import load_digits
+
+    d = os.path.join(root, "data", "digits28")
+    if all(os.path.isfile(os.path.join(d, f))
+           for f in ("train.csv", "test.csv")):
+        return d
+    X, y = load_digits(return_X_y=True)
+    X = X.reshape(-1, 8, 8) / 16.0
+    X28 = np.stack([ndimage.zoom(img, 3.5, order=1) for img in X])
+    X28 = np.clip(X28 * 255.0, 0, 255).astype(np.uint8).reshape(len(X), -1)
+
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(len(X28))
+    n_test = len(X28) // 5
+    splits = {"train.csv": idx[n_test:], "test.csv": idx[:n_test]}
+    for name, rows in splits.items():
+        path = os.path.join(d, name)
+        if not os.path.exists(path):
+            # temp-write + atomic rename: an interrupted run must never
+            # leave a truncated CSV that later gates silently train on
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("label," + ",".join(
+                    f"pixel{i}" for i in range(784)) + "\n")
+                for r in rows:
+                    f.write(str(int(y[r])) + "," + ",".join(
+                        map(str, X28[r])) + "\n")
+            os.replace(tmp, path)
+    return d

@@ -182,42 +182,12 @@ def gate_digits():
 
 
 def ensure_digits28_csvs() -> str:
-    """Generate the digits28 CSVs (sklearn's bundled digits upsampled to
-    28×28, seeded 80/20 split) if absent; returns the dataset dir. Cheap
-    and deterministic — gitignored data/ regenerates identically on any
-    host, so every digits28 consumer (gate, parity runbook, eval-only
-    driver, visual check) calls this instead of requiring a checkout."""
-    from scipy import ndimage
-    from sklearn.datasets import load_digits
+    """Generate the digits28 CSVs if absent; returns the dataset dir.
+    Implementation lives in the package (``dcnn_tpu.data.digits28``) so
+    tests and examples share it without sys.path games."""
+    from dcnn_tpu.data.digits28 import ensure_digits28_csvs as _ensure
 
-    d = os.path.join(ROOT, "data", "digits28")
-    if all(os.path.isfile(os.path.join(d, f))
-           for f in ("train.csv", "test.csv")):
-        return d
-    X, y = load_digits(return_X_y=True)
-    X = X.reshape(-1, 8, 8) / 16.0
-    X28 = np.stack([ndimage.zoom(img, 3.5, order=1) for img in X])
-    X28 = np.clip(X28 * 255.0, 0, 255).astype(np.uint8).reshape(len(X), -1)
-
-    os.makedirs(d, exist_ok=True)
-    rng = np.random.default_rng(0)
-    idx = rng.permutation(len(X28))
-    n_test = len(X28) // 5
-    splits = {"train.csv": idx[n_test:], "test.csv": idx[:n_test]}
-    for name, rows in splits.items():
-        path = os.path.join(d, name)
-        if not os.path.exists(path):
-            # temp-write + atomic rename: an interrupted run must never
-            # leave a truncated CSV that later gates silently train on
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write("label," + ",".join(
-                    f"pixel{i}" for i in range(784)) + "\n")
-                for r in rows:
-                    f.write(str(int(y[r])) + "," + ",".join(
-                        map(str, X28[r])) + "\n")
-            os.replace(tmp, path)
-    return d
+    return _ensure(ROOT)
 
 
 def gate_digits28():

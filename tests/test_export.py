@@ -126,16 +126,13 @@ def test_committed_artifacts_hit_committed_accuracy():
     checkpoint machinery. (Reference analog: mnist_cnn_test.cpp evaluates
     a saved snapshot; here the saved *program* is what evaluates.)"""
     import os
-    import sys
+
+    from dcnn_tpu.data import MNISTDataLoader
+    from dcnn_tpu.data.digits28 import ensure_digits28_csvs
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     snap = os.path.join(repo, "model_snapshots", "mnist_cnn_model")
-    sys.path.insert(0, os.path.join(repo, "examples"))
-    import accuracy_gates
-
-    from dcnn_tpu.data import MNISTDataLoader
-
-    csv = os.path.join(accuracy_gates.ensure_digits28_csvs(), "test.csv")
+    csv = os.path.join(ensure_digits28_csvs(repo), "test.csv")
     val = MNISTDataLoader(csv, data_format="NCHW", batch_size=512,
                           shuffle=False, drop_last=False)
     val.load_data()
