@@ -57,7 +57,8 @@ def run() -> dict:
             got = fn(dq, dk, dv)
             ok, err = check_match(got, want, TOL)
             # attention output has q's shape: feed it back as q
-            dt = time_chained(fn, (dq, dk, dv), replace_feed(0), length=length)
+            dt, _ = time_chained(fn, (dq, dk, dv), replace_feed(0),
+                                 length=length)
             results.append(Result(f"attn_fwd_{name}_S{s}", dt,
                                   flops / dt / 1e12, "TFLOP/s", ok, err))
 
@@ -74,7 +75,7 @@ def run() -> dict:
             oks, errs = zip(*(check_match(gg, wg, TOL)
                               for gg, wg in zip(got_g, want_g)))
             # (dq,dk,dv) grads match (q,k,v) shapes: full tuple replacement
-            dt = time_chained(gfn, (dq, dk, dv), outputs_as_args_feed(),
+            dt, _ = time_chained(gfn, (dq, dk, dv), outputs_as_args_feed(),
                               length=length)
             results.append(Result(f"attn_bwd_{name}_S{s}", dt,
                                   3.5 * flops / dt / 1e12, "TFLOP/s",

@@ -79,7 +79,7 @@ def run() -> dict:
                 _torch_conv_fp64(x[:ORACLE_BATCH], w, s, p), TOLS[mode])
             oh = got.shape[2]
             flops = 2.0 * batch * cout * cin * k * k * oh * oh
-            dt = time_chained(
+            dt, _ = time_chained(
                 lambda xx, ww: fwd(xx, ww, stride=s, padding=p),
                 (dx, dw), dep_feed(0), length=length)
             results.append(Result(f"conv_fwd_{tag}", dt, flops / dt / 1e12,
@@ -97,7 +97,7 @@ def run() -> dict:
 
             got_wg = wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p)
             ok, err = check_match(got_wg, want_wg, TOLS[mode])
-            dt = time_chained(
+            dt, _ = time_chained(
                 lambda xx, gg: wgrad(xx, gg, kernel_hw=(k, k), stride=s,
                                      padding=p),
                 (dx, dg), dep_feed(0), length=length)
@@ -106,7 +106,7 @@ def run() -> dict:
 
             got_ig = igrad(dw, dg, input_shape=x.shape, stride=s, padding=p)
             ok, err = check_match(got_ig, want_ig, TOLS[mode])
-            dt = time_chained(
+            dt, _ = time_chained(
                 lambda ww, gg: igrad(ww, gg, input_shape=x.shape, stride=s,
                                      padding=p),
                 (dw, dg), dep_feed(0), length=length)

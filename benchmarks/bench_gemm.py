@@ -50,7 +50,8 @@ def run() -> dict:
                       else max(32, min(2048, int(32 * (4096 / m) ** 2))))
             # square matmul: the output IS the next iteration's lhs — full
             # consumption, zero dependency overhead
-            dt = time_chained(mm, (da, db), replace_feed(0), length=length)
+            dt, _ = time_chained(mm, (da, db), replace_feed(0),
+                                 length=length)
             gflops = 2.0 * m * n * k / dt / 1e9
             results.append(Result(
                 name=f"gemm_{m}x{n}x{k}_{mode}", seconds=dt, rate=gflops,

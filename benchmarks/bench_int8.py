@@ -60,7 +60,7 @@ def _conv_micro(results, rng, batch, length):
         want = _torch_conv_int_exact(x_q[:oracle_batch], w_q, 1, 1)
         ok = bool(np.array_equal(got, want))
         err = float(np.abs(got - want).max()) if not ok else 0.0
-        dt = time_chained(fwd8, (dx, dw), dep_feed(0), length=length)
+        dt, _ = time_chained(fwd8, (dx, dw), dep_feed(0), length=length)
         results.append(Result(f"conv_int8_{tag}", dt, flops / dt / 1e12,
                               "TOP/s", ok, err))
 
@@ -76,7 +76,7 @@ def _conv_micro(results, rng, batch, length):
         ).astype(ftype)
         fwd16 = jax.jit(lambda xx, ww: conv_ops.conv2d(
             xx, ww, stride=1, padding=1, data_format="NCHW"))
-        dt = time_chained(fwd16, (xb, wb), dep_feed(0), length=length)
+        dt, _ = time_chained(fwd16, (xb, wb), dep_feed(0), length=length)
         set_precision("parity")
         results.append(Result(f"conv_bf16_{tag}", dt, flops / dt / 1e12,
                               "TFLOP/s", True, 0.0))

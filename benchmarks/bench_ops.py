@@ -59,7 +59,7 @@ def run() -> dict:
         tol = TOL * (np.sqrt(n) / 100 if scalar_out else 1.0)
         ok, err = check_match(got, oracle(), tol)
         feed = dep_feed(0) if scalar_out else replace_feed(0)
-        dt = time_chained(fn, args, feed, length=length)
+        dt, _ = time_chained(fn, args, feed, length=length)
         gbps = n_arrays * n * itemsize / dt / 1e9
         results.append(Result(f"ew_{name}", dt, gbps, "GB/s", ok, err))
 
@@ -79,7 +79,7 @@ def run() -> dict:
         dx4 = jax.device_put(x4)
         got = jax.jit(fn)(dx4)
         ok, err = check_match(got, x4.transpose(perm), TOL)
-        dt = time_chained(fn, (dx4,), replace_feed(0), length=length)
+        dt, _ = time_chained(fn, (dx4,), replace_feed(0), length=length)
         gbps = 2 * x4.nbytes / dt / 1e9
         results.append(Result(f"layout_{name}", dt, gbps, "GB/s", ok, err))
     return report("tensor_ops", results, meta={"elements": n})

@@ -64,7 +64,7 @@ def run():
                 preferred_element_type=jnp.float32).astype(jnp.bfloat16)
 
         ref = np.asarray(xla_conv(x, wt), np.float32)
-        dt_xla = time_chained(xla_conv, (x, wt), feed)
+        dt_xla, _ = time_chained(xla_conv, (x, wt), feed)
         results.append(Result(
             f"xla_conv_{h}x{w}x{cin}", dt_xla, flops / dt_xla / 1e12,
             "TF/s", True, 0.0, extra={"B": b}))
@@ -87,7 +87,7 @@ def run():
                     got = np.asarray(pk(x, wt), np.float32)
                     err = float(np.max(np.abs(got - ref)))
                     ok = err < 0.75  # bf16 on K up to 4608
-                    dt = time_chained(pk, (x, wt), feed)
+                    dt, _ = time_chained(pk, (x, wt), feed)
                     if best is None or dt < best[0]:
                         best = (dt, bt, ok, err)
                 except Exception as e:  # noqa: BLE001 — record, keep going.

@@ -99,15 +99,19 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     """Per-iteration seconds for ``length`` data-dependent iterations of
     ``op`` inside ONE jitted dispatch (``lax.scan``).
 
+    Returns ``(seconds, sane)`` — ALWAYS a tuple, with or without
+    ``roofline`` (the r5 polymorphic bare-float return invited silent
+    tuple-as-number bugs, ADVICE r5); ``sane`` is True whenever no gate
+    fired.
+
     ``roofline=(flops_per_iteration, peak_flops_or_None)``: physical sanity
     gate. One capture of a short inference chain measured an implied 232
     TF/s bf16 forward — above the 197 TF/s v5e peak, i.e. impossible: the
     two-length delta occasionally lands on correlated tunnel jitter. With
     ``roofline`` set the measurement is retried up to twice while the
-    implied FLOP rate exceeds 1.05× peak, and the return becomes a tuple
-    ``(seconds, sane)`` so callers can flag (never silently report) a
-    persistently impossible number. ``peak=None`` skips the check but keeps
-    the tuple shape.
+    implied FLOP rate exceeds 1.05× peak, and ``sane`` becomes False when a
+    persistently impossible number remains, so callers can flag (never
+    silently report) it. ``peak=None`` skips the check.
 
     On tunnelled/remote PJRT backends a single dispatch costs ~10 ms wall
     regardless of the op, so ``time_callable`` measures the tunnel, not the
@@ -138,7 +142,7 @@ def time_chained(op: Callable, args: tuple, feed: Callable,
     def _gated(measure):
         dt = measure()
         if roofline is None:
-            return dt
+            return dt, True
         flops, peak = roofline
         tries = 0
         while peak and flops / dt > 1.05 * peak and tries < 2:

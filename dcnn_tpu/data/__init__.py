@@ -12,6 +12,7 @@ from .prefetch import PrefetchLoader
 from .streaming import (
     StreamingDeviceDataset, make_shard_step, train_streaming_epoch,
 )
+from .transfer import TransferEngine, chunk_bounds, max_inflight
 from .augment import (
     AugmentationBuilder, AugmentationStrategy,
     brightness, contrast, cutout, gaussian_noise, horizontal_flip,
@@ -31,6 +32,7 @@ __all__ = [
     "SyntheticClassificationLoader",
     "PrefetchLoader",
     "StreamingDeviceDataset", "make_shard_step", "train_streaming_epoch",
+    "TransferEngine", "chunk_bounds", "max_inflight",
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",

@@ -53,11 +53,17 @@ class DeviceDataset:
          drop_last batching).
       augment: optional ``DeviceAugment`` applied after decode, per batch.
       scale: decode multiplier (default 1/255 for uint8 inputs, 1 for float).
+      transfer_engine: optional ``data.transfer.TransferEngine``
+         (caller-owned) for the one-time staging put — the multi-GB initial
+         H2D is chunked across the engine's transfer threads (pipelined
+         wire, same bytes on device) instead of one blocking ``device_put``.
+         The reassembly transiently needs ~2x the split in HBM (chunks +
+         concatenated output); for splits near HBM capacity stage plainly.
     """
 
     def __init__(self, x: np.ndarray, y: np.ndarray, num_classes: int, *,
                  batch_size: int, augment: Optional[Callable] = None,
-                 scale: Optional[float] = None):
+                 scale: Optional[float] = None, transfer_engine=None):
         x = np.asarray(x)
         y = np.asarray(y)
         if y.ndim == 2:  # accept one-hot and collapse: labels live as int32
@@ -73,8 +79,10 @@ class DeviceDataset:
                            else (1.0 / 255.0 if x.dtype == np.uint8 else 1.0))
         self.num_samples = len(x)
         self.sample_shape = x.shape[1:]
-        # staged once; uint8 stays uint8 in HBM (decode happens in-step)
-        self.x = jax.device_put(x)
+        # staged once; uint8 stays uint8 in HBM (decode happens in-step).
+        # Labels are KB-scale — chunking them buys nothing, ship plainly.
+        self.x = (transfer_engine.put_array(x) if transfer_engine is not None
+                  else jax.device_put(x))
         self.y = jax.device_put(y.astype(np.int32))
 
     @property

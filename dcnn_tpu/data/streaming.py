@@ -10,10 +10,12 @@ Fills the gap between the two existing feed paths (VERDICT r3 missing #6):
 Here the dataset lives in host RAM as uint8; it streams through HBM in
 **shards** of K batches with double buffering: while shard *i* trains
 (one fused dispatch: on-device shuffle → decode → augment → one-hot →
-K train steps), shard *i+1* rides a single async ``device_put``. Shard
-buffers are donated to the dispatch, so steady-state HBM holds ~2 shards
-regardless of dataset size. This is the TPU-native analog of the
-reference's chunked batch loader feeding the accelerator
+K train steps), shard *i+1* rides the chunked multi-stream transfer
+engine (``data/transfer.py``) — C chunks gathered chunk-parallel and
+shipped by a pool of transfer threads, several H2D copies in flight at
+once. Shard buffers are donated to the dispatch, so steady-state HBM
+holds ~2 shards regardless of dataset size. This is the TPU-native
+analog of the reference's chunked batch loader feeding the accelerator
 (``include/data_loading/data_loader.hpp:25-187`` prepare_batches +
 to_device), with the transfer/compute overlap its threading provides.
 
@@ -36,7 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.fence import hard_fence
+from .. import native
+from .transfer import TransferEngine
 
 
 def make_shard_step(model, loss_fn: Callable, optimizer, *, num_classes: int,
@@ -60,6 +63,13 @@ def make_shard_step(model, loss_fn: Callable, optimizer, *, num_classes: int,
     k, b = shard_batches, batch_size
 
     def step(ts, x_u8, y, rng, lr):
+        if isinstance(x_u8, (tuple, list)):
+            # chunk-tuple feed (transfer.TransferEngine reassemble="chunks"):
+            # concatenating INSIDE the jitted step folds the reassembly into
+            # the shard dispatch — no separate device-side copy pass. The
+            # tuple arity is fixed per engine, so one executable serves
+            # every shard.
+            x_u8 = jnp.concatenate(x_u8, axis=0)
         if x_u8.shape[0] != k * b:
             raise ValueError(f"shard must hold exactly {k}x{b} samples, "
                              f"got {x_u8.shape[0]}")
@@ -109,42 +119,74 @@ class StreamingDeviceDataset:
     def steps_per_epoch(self) -> int:
         return self.num_shards * self.shard_batches
 
-    def shards(self):
-        """Yield (x_u8_shard, y_shard) host arrays in a fresh random order;
-        samples are globally permuted each epoch so shard membership and
-        the dropped remainder rotate."""
+    def shard_selections(self):
+        """Yield one sorted int64 row-selection per shard in a fresh random
+        order; samples are globally permuted each epoch so shard membership
+        and the dropped remainder rotate. The selection (not the gathered
+        copy) is the unit the transfer engine consumes: each chunk task
+        gathers its own row range, making the gather chunk-parallel."""
         perm = self._rng.permutation(len(self.x))
         for s in range(self.num_shards):
             sel = perm[s * self.shard_samples:(s + 1) * self.shard_samples]
             sel.sort()  # contiguous-ish gather: faster host copy
-            yield self.x[sel], self.y[sel]
+            yield sel.astype(np.int64, copy=False)
+
+    def shards(self):
+        """Yield (x_u8_shard, y_shard) host arrays (materialized). The
+        gather runs through the native chunk-parallel row-memcpy kernel
+        (``native.gather_rows``, bit-identical numpy fancy-index fallback
+        when the toolchain is absent) instead of single-threaded numpy
+        fancy indexing."""
+        for sel in self.shard_selections():
+            yield native.gather_rows(self.x, sel), native.gather_rows(
+                self.y, sel)
 
 
 def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                           lr: float, *,
-                          timeline: Optional[List[dict]] = None):
+                          timeline: Optional[List[dict]] = None,
+                          engine: Optional[TransferEngine] = None):
     """One epoch with a producer thread feeding a bounded queue: the host
-    side of the feed (shard gather — a fancy-index copy that costs real time
-    on a 1-core host — plus the ``device_put`` issue, which on a tunnelled
-    TPU blocks for the full wire transfer) runs on its own thread, so it
-    overlaps the device compute the consumer loop dispatches. numpy fancy
-    indexing and the PjRt host-to-device path both release the GIL, so the
-    overlap is real even on one core.
+    side of the feed runs on its own thread(s), so it overlaps the device
+    compute the consumer loop dispatches.
 
-    The r4 single-thread version interleaved gather/put/dispatch in ONE
-    Python loop: every per-shard host cost (gather + blocking put) was
-    serial with the dispatch cadence, capping overlap_efficiency at 0.40 on
-    the bench host (RESULTS.md r4). Queue depth 1 bounds steady-state HBM at
-    ~3 shards (computing + queued + in-transfer).
+    The feed itself is the chunked multi-stream **transfer engine**
+    (``data/transfer.py``): each shard is split into C chunks, gathered
+    (chunk-parallel native row memcpy) and shipped by a small pool of
+    transfer threads so several H2D copies are in flight at once —
+    pipelining the wire on tunnelled/latency-bound hosts — then handed to
+    ``make_shard_step`` as a chunk tuple (concatenated inside the shard
+    dispatch; no device-side copy pass). The r5 version issued ONE blocking
+    ``device_put`` per shard on one thread; its 8.13 s per-shard put was
+    nearly the whole 8.78 s epoch wall on the bench host (BENCH_r05,
+    `host_feed_efficiency` 0.042). numpy/native gathers and the PjRt
+    host-to-device path all release the GIL, so the overlap is real even on
+    one core. Queue depth 1 bounds steady-state HBM at ~3 shards (computing
+    + queued + in-transfer).
+
+    ``engine``: a configured :class:`~dcnn_tpu.data.transfer.TransferEngine`
+    (caller-owned). Default: a private engine with 4 chunks x 2 transfer
+    threads, closed when the epoch returns.
+    ``TransferEngine(num_chunks=1, num_threads=1, reassemble="concat")``
+    reproduces the r5 monolithic path exactly (the bit-identity reference
+    in tests/test_transfer.py).
 
     ``timeline``: pass a list to receive one dict per shard —
-    ``{shard, gather_s, put_s, queue_wait_s, dispatch_s, put_done_t,
-    dispatch_t}`` (absolute times relative to epoch start) — the
-    measurement surface for the overlap accounting in RESULTS.md.
+    ``{shard, gather_s, put_s, feed_wall_s, queue_wait_s, dispatch_s,
+    put_done_t, dispatch_t, chunks, inflight_max, h2d_gbps, bytes}``.
+    ``gather_s`` sums the per-chunk gather walls, ``put_s`` is the UNION of
+    the put spans (overlapped transfers don't double-count), ``chunks``
+    carries the raw per-chunk spans, ``inflight_max`` the peak number of
+    concurrently in-flight chunk transfers, and ``h2d_gbps`` the effective
+    rate over the union wall — the measurement surface for the overlap
+    accounting in RESULTS.md.
 
     Returns (ts, mean_loss)."""
-    dev = jax.devices()[0]
     t_epoch0 = time.perf_counter()
+    own_engine = engine is None
+    if own_engine:
+        engine = TransferEngine(num_chunks=4, num_threads=2,
+                                reassemble="chunks")
     q: "queue.Queue" = queue.Queue(maxsize=1)
     stop = threading.Event()
 
@@ -160,33 +202,39 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                 continue
         return False
 
+    def shard_plan():
+        # prefer the selection iterator (chunk-parallel gather inside the
+        # engine's pool tasks); fall back to materialized shards for
+        # dataset-likes that only expose shards()
+        if hasattr(dataset, "shard_selections"):
+            for sel in dataset.shard_selections():
+                yield dataset.x, dataset.y, sel
+        else:
+            for sx, sy in dataset.shards():
+                yield sx, sy, None
+
     def producer():
         # the terminating sentinel is (None | exception): a producer-side
-        # failure (device_put OOM, tunnel error, a raising shards()) must
+        # failure (device_put OOM, tunnel error, a raising chunk task) must
         # reach the consumer as a re-raised exception, never as a silent
         # missing sentinel that would park q.get() forever
         err = None
         try:
-            it = dataset.shards()
+            it = shard_plan()
             i = 0
             while not stop.is_set():
-                t0 = time.perf_counter()
                 nxt = next(it, None)
-                t1 = time.perf_counter()
                 if nxt is None:
                     break
-                sx = jax.device_put(nxt[0], dev)
-                sy = jax.device_put(nxt[1], dev)
-                # fence the staged shard: device_put is async-ISSUE on the
-                # tunnelled backend (returns in ms while the bytes are still
-                # crossing the wire), so without this the queue would pace on
-                # issue time and the timeline's put_s would not measure the
-                # transfer. The fence runs on this producer thread, so the
-                # consumer's dispatches still overlap it.
-                hard_fence(sx)
-                t2 = time.perf_counter()
+                # per-chunk fencing happens on the engine's pool threads
+                # (device_put is async-ISSUE on the tunnelled backend —
+                # without the fence the queue would pace on issue time and
+                # the spans would not measure the transfer); the consumer's
+                # dispatches still overlap the whole shipment.
+                sx, sy, stats = engine.put_shard(nxt[0], nxt[1], nxt[2],
+                                                t_base=t_epoch0)
                 if not put_or_stop(
-                        (i, sx, sy, t1 - t0, t2 - t1, t2 - t_epoch0)):
+                        (i, sx, sy, stats, time.perf_counter() - t_epoch0)):
                     return
                 i += 1
         except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
@@ -205,20 +253,28 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                 break
             if isinstance(item, BaseException):
                 raise item
-            i, sx, sy, gather_s, put_s, put_done_t = item
+            i, sx, sy, stats, put_done_t = item
             t4 = time.perf_counter()
             ts, loss = step(ts, sx, sy, jax.random.fold_in(rng, i), lr)
             t5 = time.perf_counter()
             losses.append(loss)
             if timeline is not None:
                 timeline.append({
-                    "shard": i, "gather_s": gather_s, "put_s": put_s,
+                    "shard": i, "gather_s": stats["gather_s"],
+                    "put_s": stats["put_s"],
+                    "feed_wall_s": stats["wall_s"],
                     "queue_wait_s": t4 - t3, "dispatch_s": t5 - t4,
                     "put_done_t": put_done_t,
-                    "dispatch_t": t5 - t_epoch0})
+                    "dispatch_t": t5 - t_epoch0,
+                    "chunks": stats["chunks"],
+                    "inflight_max": stats["inflight_max"],
+                    "h2d_gbps": stats["h2d_gbps"],
+                    "bytes": stats["bytes"]})
     finally:
         stop.set()
         worker.join(timeout=60.0)
+        if own_engine:
+            engine.close()
     # ONE on-device reduction + ONE readback: per-loss float() readbacks
     # measured ~3 s EACH on the tunnelled backend (13.6 s vs 0.41 s for a
     # 4-shard epoch) and were the r4 "overlap stalls at 0.40" culprit

@@ -112,6 +112,14 @@ def lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.c_int64, ctypes.c_int32]
             getattr(l, fn).restype = ctypes.c_int
+    # gather.cpp postdates some deployed .so builds — same degrade-gracefully
+    # treatment as the lz4 symbols
+    if hasattr(l, "dcnn_gather_rows"):
+        l.dcnn_gather_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64]
+        l.dcnn_gather_rows.restype = ctypes.c_int
     _lib = l
     return _lib
 
@@ -183,6 +191,45 @@ def lz4_decompress(data: bytes, raw_size: int) -> Optional[bytes]:
 
 def available() -> bool:
     return lib() is not None
+
+
+def gather_available() -> bool:
+    l = lib()
+    return l is not None and hasattr(l, "dcnn_gather_rows")
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Row gather ``src[idx]`` — chunk-parallel native memcpy when the
+    library is available, numpy fancy indexing otherwise. Bit-identical to
+    ``src[idx]`` either way (the kernel is a pure per-row memcpy), which the
+    streaming feed's numerics-parity guarantee depends on. Indices must be
+    in ``[0, len(src))`` — negatives raise IndexError on BOTH paths (the
+    native kernel cannot wrap, and allowing numpy wrap-around only in the
+    fallback would make behavior toolchain-dependent)."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    if idx.ndim != 1:
+        raise ValueError(f"gather_rows needs a 1-D index, got {idx.ndim}-D")
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= src.shape[0]):
+        raise IndexError(
+            f"gather_rows: index out of range [0, {src.shape[0]})")
+    l = lib()
+    if l is None or not hasattr(l, "dcnn_gather_rows") or src.ndim == 0:
+        return src[idx]
+    row_bytes = src.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes == 0:  # zero-size trailing dims: nothing to copy natively
+        return src[idx]
+    dst = np.empty((idx.size, *src.shape[1:]), src.dtype)
+    rc = l.dcnn_gather_rows(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        idx.size, row_bytes, src.shape[0])
+    if rc != 0:
+        raise IndexError(
+            f"gather_rows: index out of range for axis 0 of size "
+            f"{src.shape[0]}")
+    return dst
 
 
 def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0) -> np.ndarray:

@@ -22,6 +22,7 @@ batch statistics to update, so training it would silently skip BN.
 
 from __future__ import annotations
 
+import copy
 from typing import Any, List, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -87,7 +88,13 @@ def _fold_list(layers: Sequence, params: Sequence, state: Sequence
             continue
         # unchanged layer: rebuild from config so the folded model shares no
         # (mutable) layer objects with the original
-        out_l.append(layer_from_config(layer.get_config()))
+        try:
+            out_l.append(layer_from_config(layer.get_config()))
+        except ValueError:
+            # pass-through custom layer outside the factory registry: a
+            # shallow copy keeps the folded graph independent without
+            # refusing to fold the rest of the model (ADVICE r5)
+            out_l.append(copy.copy(layer))
         out_p.append(lp)
         out_s.append(ls)
         i += 1

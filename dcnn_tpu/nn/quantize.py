@@ -31,6 +31,7 @@ through the layer factory and the checkpoint format like any other layer
 
 from __future__ import annotations
 
+import copy
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -259,7 +260,14 @@ def _quantize_list(layers: Sequence, params: Sequence, state: Sequence, x,
             out_p.append({"main": tuple(mp), "shortcut": tuple(sp)})
             out_s.append({"main": tuple(ms), "shortcut": tuple(ss)})
         else:
-            out_l.append(layer_from_config(layer.get_config()))
+            try:
+                out_l.append(layer_from_config(layer.get_config()))
+            except ValueError:
+                # pass-through custom layer outside the factory registry:
+                # reuse a shallow copy rather than refusing to quantize the
+                # whole model — it carries no int8 twin either way, and the
+                # copy keeps the returned graph independent of the original
+                out_l.append(copy.copy(layer))
             out_p.append(lp)
             out_s.append(ls)
         x = (advanced if advanced is not None

@@ -42,13 +42,14 @@ def test_serialization_section_runs_and_gates():
 
 
 def test_time_chained_roofline_gate(monkeypatch):
-    """The roofline= contract: without it, a scalar; with it, (seconds,
-    sane) — and an implied FLOP rate above 1.05x peak is retried then
-    flagged sane=False rather than silently returned (the guard behind the
-    int8 e2e rows; see RESULTS.md measurement-spread postmortem). The
-    backend is pinned to the CPU per-dispatch fallback so the forced-insane
-    case never chases the TPU noise-floor escalation (minutes on a real
-    chip for a trivial op)."""
+    """The return contract: ALWAYS (seconds, sane) — sane=True when no
+    roofline gate fired (ADVICE r5: the old polymorphic bare-float return
+    invited silent tuple-as-number bugs) — and an implied FLOP rate above
+    1.05x peak is retried then flagged sane=False rather than silently
+    returned (the guard behind the int8 e2e rows; see RESULTS.md
+    measurement-spread postmortem). The backend is pinned to the CPU
+    per-dispatch fallback so the forced-insane case never chases the TPU
+    noise-floor escalation (minutes on a real chip for a trivial op)."""
     import jax
     import jax.numpy as jnp
 
@@ -58,8 +59,9 @@ def test_time_chained_roofline_gate(monkeypatch):
     x = jnp.ones((8, 8), jnp.float32)
     op = lambda a: a * 2.0
 
-    dt = time_chained(op, (x,), dep_feed(0), length=4)
+    dt, sane = time_chained(op, (x,), dep_feed(0), length=4)
     assert isinstance(dt, float) and dt > 0
+    assert sane is True
 
     # absurdly high peak -> any measurement is sane
     dt, sane = time_chained(op, (x,), dep_feed(0), length=4,

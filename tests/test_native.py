@@ -224,3 +224,47 @@ def test_shuffle_zstd_codec_beats_plain_zstd_on_floats(rng):
     odd = payload[:4093]
     blob2 = mc.compress(odd, ShuffleZstdCompressor(typesize=4))
     assert mc.decompress(blob2) == odd
+
+
+@requires_native
+def test_gather_rows_matches_fancy_index(rng):
+    """The chunk-parallel row gather feeding the transfer engine must be
+    bit-identical to numpy fancy indexing for any dtype/row size."""
+    for src in (rng.integers(0, 256, size=(50, 6, 6, 3), dtype=np.uint8),
+                rng.normal(size=(40, 17)).astype(np.float32),
+                rng.integers(0, 9, size=37).astype(np.int32)):
+        idx = rng.integers(0, src.shape[0], size=23).astype(np.int64)
+        np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+    # empty selection
+    empty = native.gather_rows(np.arange(12).reshape(4, 3),
+                               np.empty(0, np.int64))
+    assert empty.shape == (0, 3)
+
+
+@requires_native
+def test_gather_rows_out_of_range_raises(rng):
+    src = rng.integers(0, 256, size=(10, 4), dtype=np.uint8)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 10], np.int64))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-1], np.int64))
+
+
+def test_gather_rows_numpy_fallback_parity(monkeypatch, rng):
+    """With the native library unavailable the MANDATORY numpy fallback
+    must produce the same bytes (the transfer engine's bit-identity
+    guarantee cannot depend on the toolchain)."""
+    src = rng.integers(0, 256, size=(30, 5, 2), dtype=np.uint8)
+    idx = rng.integers(0, 30, size=12).astype(np.int64)
+    want = native.gather_rows(src, idx)
+    monkeypatch.setattr(native, "lib", lambda: None)
+    assert not native.gather_available()
+    got = native.gather_rows(src, idx)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, src[idx])
+    # out-of-range (incl. negative) indices raise on the fallback path too —
+    # behavior must not depend on whether the toolchain is present
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-1], np.int64))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([30], np.int64))

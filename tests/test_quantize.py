@@ -290,3 +290,42 @@ def test_quantize_does_not_mutate_original():
                    jnp.ones((4, 8, 8, 3), jnp.float32))
     np.testing.assert_array_equal(np.asarray(ts.params[0]["w"]), w_before)
     assert not model.layers[0].use_bias
+
+
+def test_quantize_passes_through_unregistered_custom_layer():
+    """A custom layer whose type is outside the factory registry must pass
+    through quantization as a (copied) pass-through, not abort the whole
+    model with "unknown layer type" (ADVICE r5): PTQ only needs to rebuild
+    the layers it quantizes."""
+    from dcnn_tpu.nn import (DenseLayer, FlattenLayer, Sequential,
+                             StatelessLayer)
+    from dcnn_tpu.nn.factory import LayerFactory
+
+    class DoubleLayer(StatelessLayer):
+        type_name = "test_unregistered_double"
+
+        def forward(self, x, *, training=False, rng=None):
+            return x * 2.0
+
+    assert "test_unregistered_double" not in LayerFactory.registered()
+
+    model = Sequential([FlattenLayer(), DoubleLayer(), DenseLayer(10)],
+                       name="custom_q", input_shape=(4, 4, 1))
+    params, state = model.init(jax.random.PRNGKey(0), (4, 4, 1))
+    calib = jnp.asarray(np.random.default_rng(11).normal(
+        size=(16, 4, 4, 1)).astype(np.float32))
+    qm, qp, qs = quantize_model(model, params, state, calib)
+
+    # the custom layer survives as a pass-through COPY (the returned graph
+    # stays independent of the original), the dense still quantizes
+    assert isinstance(qm.layers[1], DoubleLayer)
+    assert qm.layers[1] is not model.layers[1]
+    assert isinstance(qm.layers[2], QuantDenseLayer)
+
+    x = jnp.asarray(np.random.default_rng(12).normal(
+        size=(4, 4, 4, 1)).astype(np.float32))
+    y_f, _ = model.apply(params, state, x, training=False)
+    y_q, _ = qm.apply(qp, qs, x, training=False)
+    cos = float(np.sum(np.asarray(y_f) * np.asarray(y_q)) /
+                (np.linalg.norm(y_f) * np.linalg.norm(y_q) + 1e-12))
+    assert cos > 0.99, f"quantized custom-layer model diverged: cosine {cos}"

@@ -116,10 +116,10 @@ def test_streaming_producer_failure_propagates():
     class Boom(RuntimeError):
         pass
 
-    def bad_shards():
-        yield next(iter(ds.__class__.shards(ds)))
+    def bad_selections():
+        yield next(iter(ds.__class__.shard_selections(ds)))
         raise Boom("host feed died")
-    ds.shards = bad_shards
+    ds.shard_selections = bad_selections
     step = make_shard_step(model, softmax_cross_entropy, opt, num_classes=4,
                            batch_size=8, shard_batches=4)
     import time
