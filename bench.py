@@ -42,7 +42,10 @@ chunk 20, batch 2048; the in-jit loop amortizes per-dispatch launch
 latency), BENCH_FORMAT (NHWC default — TPU-preferred tiling),
 BENCH_MATRIX=1 for the layout/dtype sweep, BENCH_RESIDENT_SAMPLES
 (resident-path dataset size, default 51200), BENCH_PROFILE=/path to dump a
-jax.profiler trace.
+jax.profiler trace, BENCH_SERVE=1 for the online-serving
+latency-vs-offered-load curve (dcnn_tpu/serve/; knobs
+BENCH_SERVE_LOADS/_SECONDS/_MAX_BATCH/_WAIT_MS/_QUEUE/_INT8 — emitted
+under a "serving" key).
 """
 
 from __future__ import annotations
@@ -443,6 +446,104 @@ def int8_inference_section(data_format: str):
     return batch / dt_f, batch / dt_q
 
 
+def serve_section(data_format, engine=None, loads=None, seconds=None):
+    """BENCH_SERVE=1: online-serving latency vs offered load
+    (dcnn_tpu/serve/ — bucketed compiled sessions + dynamic batcher;
+    RESULTS.md 'Online serving'). Open-loop single-sample arrivals at each
+    offered rate; the returned block carries, per point, achieved
+    throughput, p50/p95/p99 latency, mean batch occupancy, and the shed
+    fraction — the four numbers that together say whether the batcher is
+    turning offline img/s into a servable p99 or just queueing.
+
+    ``engine``/``loads``/``seconds`` are injectable for the tier-1
+    structure test; the bench path builds a ResNet-18 engine (int8 by
+    default — the serving graph of record; BENCH_SERVE_INT8=0 for folded
+    float) and derives default loads from a measured closed-loop capacity
+    probe so the curve always brackets saturation (~0.25x/0.5x/1x)."""
+    import numpy as np
+    import jax
+
+    from dcnn_tpu.serve import DynamicBatcher, InferenceEngine, \
+        ServeMetrics, open_loop
+
+    on_tpu = jax.default_backend() == "tpu"
+    if engine is None:
+        from dcnn_tpu.models import create_resnet18_tiny_imagenet
+        from dcnn_tpu.optim import Adam
+        from dcnn_tpu.train.trainer import create_train_state
+
+        mb = int(os.environ.get("BENCH_SERVE_MAX_BATCH",
+                                "64" if on_tpu else "8"))
+        model = create_resnet18_tiny_imagenet(data_format)
+        ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(9))
+        rng = np.random.default_rng(11)
+        calib = None
+        if os.environ.get("BENCH_SERVE_INT8", "1") == "1":
+            calib = rng.normal(size=(32, *model.input_shape)
+                               ).astype(np.float32)
+        engine = InferenceEngine.from_model(model, ts.params, ts.state,
+                                            int8_calib=calib, max_batch=mb)
+
+    rng = np.random.default_rng(12)
+    pool = rng.normal(size=(max(2 * engine.max_batch, 32),
+                            *engine.input_shape)).astype(np.float32)
+
+    # closed-loop capacity probe: full-bucket dispatches back to back —
+    # the ceiling the open-loop curve is read against
+    full = pool[:engine.max_batch]
+    np.asarray(engine.run_padded(full))  # sessions are warm; settle caches
+    reps = 8
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        y = engine.run_padded(full)
+    np.asarray(y)  # host materialization fences the chain
+    capacity = reps * engine.max_batch / (time.perf_counter() - t0)
+
+    if loads is None:
+        env_loads = os.environ.get("BENCH_SERVE_LOADS")
+        if env_loads:
+            loads = [float(v) for v in env_loads.split(",")]
+        else:
+            loads = [round(capacity * f, 1) for f in (0.25, 0.5, 1.0)]
+    if seconds is None:
+        seconds = float(os.environ.get("BENCH_SERVE_SECONDS",
+                                       "5" if on_tpu else "2"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "5"))
+    qcap = int(os.environ.get("BENCH_SERVE_QUEUE",
+                              str(4 * engine.max_batch)))
+
+    points = []
+    for rps in loads:
+        metrics = ServeMetrics()
+        batcher = DynamicBatcher(engine, max_wait_ms=wait_ms,
+                                 queue_capacity=qcap, metrics=metrics)
+        open_loop(batcher, pool, rps, seconds)
+        batcher.drain(timeout=600)
+        s = metrics.snapshot()
+        rnd = lambda v, k=2: None if v is None else round(v, k)
+        points.append({
+            "offered_rps": rnd(rps, 1),
+            "achieved_rps": rnd(s["throughput_rps"], 1),
+            "p50_ms": rnd(s["p50_ms"]),
+            "p95_ms": rnd(s["p95_ms"]),
+            "p99_ms": rnd(s["p99_ms"]),
+            "batch_occupancy": rnd(s["batch_occupancy"], 3),
+            "shed_fraction": rnd(s["shed_fraction"], 4),
+            "completed": s["requests_completed"],
+        })
+    return {
+        "graph": engine.name,
+        "device_kind": jax.devices()[0].device_kind,
+        "max_batch": engine.max_batch,
+        "buckets": engine.bucket_sizes,
+        "max_wait_ms": wait_ms,
+        "queue_capacity": qcap,
+        "seconds_per_point": seconds,
+        "capacity_img_per_sec": round(capacity, 1),
+        "loads": points,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -550,6 +651,12 @@ def main() -> None:
             out["infer_bf16_img_per_sec"] = round(bf16_ips, 1)
             out["infer_int8_img_per_sec"] = round(int8_ips, 1)
             out["int8_speedup_x"] = round(int8_ips / bf16_ips, 3)
+
+    # online serving: latency-vs-offered-load curve through the dynamic
+    # batcher (opt-in — real open-loop traffic adds ~3x
+    # BENCH_SERVE_SECONDS of wall per run)
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        out["serving"] = serve_section(data_format)
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision

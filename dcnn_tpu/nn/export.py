@@ -71,5 +71,9 @@ def load_inference(blob: bytes) -> Callable:
     """Reload a serialized artifact as a callable ``f(x) -> logits``.
 
     Needs only JAX — no model class, layer registry, or checkpoint; the
-    weights live inside the artifact as constants."""
-    return jax_export.deserialize(blob).call
+    weights live inside the artifact as constants. The call is wrapped in
+    ``jax.jit`` so repeated same-shape calls hit the compile cache instead
+    of re-tracing the deserialized computation per call — the difference
+    between a serving loop and a benchmark-of-retracing (cache behavior
+    asserted in ``tests/test_export.py``)."""
+    return jax.jit(jax_export.deserialize(blob).call)

@@ -18,8 +18,8 @@ TPU-native design:
   reference's microbatch-ID caches), sync and semi-async schedules reproduce
   ``Coordinator``/``async_process_batch`` semantics.
 - **Partitioners** (``partitioner.py``): naive even-layer split (reference
-  ``NaivePartitioner``) plus the FLOP-balanced split the reference left as a
-  TODO.
+  ``NaivePartitioner``) plus the FLOP-balanced split the reference never
+  implemented.
 """
 
 from .partitioner import FlopBalancedPartitioner, NaivePartitioner, Partitioner

@@ -1,0 +1,38 @@
+"""Online inference serving: bucketed compiled sessions, dynamic batching,
+load shedding, latency metrics.
+
+The north-star asks for a system that "serves heavy traffic from millions
+of users"; the deployment transforms (``nn.fold_batchnorm``,
+``nn.quantize_model``, ``nn.export_inference``) produce the graph, and this
+subsystem puts it online:
+
+- :class:`~dcnn_tpu.serve.engine.InferenceEngine` — loads a checkpoint,
+  live model, or StableHLO artifact; pre-compiles one donated-buffer
+  session per power-of-two batch bucket and warms them, so no request ever
+  pays a compile;
+- :class:`~dcnn_tpu.serve.batcher.DynamicBatcher` — bounded thread-safe
+  queue + dispatcher that coalesces requests up to ``max_batch`` or a
+  ``max_wait_ms`` deadline, pads to the nearest bucket, and scatters
+  results through per-request futures; beyond queue capacity it sheds
+  (:class:`~dcnn_tpu.serve.batcher.QueueFullError`) instead of queueing
+  unboundedly;
+- :class:`~dcnn_tpu.serve.metrics.ServeMetrics` — rolling p50/p95/p99
+  latency, queue depth, batch occupancy, throughput, shed fraction, as a
+  snapshot dict.
+
+End-to-end drivers: ``examples/serve_snapshot.py`` (committed digits28
+snapshot under open-loop traffic) and ``BENCH_SERVE=1 python bench.py``
+(latency-vs-offered-load curve). Quickstart: docs/deployment.md §5.
+"""
+
+from .engine import InferenceEngine, serve_buckets
+from .batcher import DynamicBatcher, QueueFullError
+from .metrics import ServeMetrics
+from .traffic import open_loop
+
+__all__ = [
+    "InferenceEngine", "serve_buckets",
+    "DynamicBatcher", "QueueFullError",
+    "ServeMetrics",
+    "open_loop",
+]

@@ -1,0 +1,264 @@
+"""Dynamic batcher: the online half of the serving stack.
+
+Single requests arrive asynchronously; TPU throughput lives at large
+batches. The classic reconciliation (Clipper NSDI'17; TF-Serving's batching
+scheduler) is a **batching window**: hold the first request at most
+``max_wait_ms``, group everything that arrives meanwhile up to
+``max_batch``, run once, scatter results. This module implements that with
+
+- a **bounded queue** (capacity in samples) — the load-shedding valve:
+  beyond capacity, :meth:`DynamicBatcher.submit` raises
+  :class:`QueueFullError` *immediately* instead of letting latency grow
+  without bound (an overloaded server that queues forever serves nobody;
+  one that sheds keeps its p99 for the traffic it accepts);
+- a dispatcher thread that pops a batch when it is **due** — queue holds
+  ``max_batch`` samples, or the oldest request has waited ``max_wait_ms``,
+  or the batcher is draining — pads it to the engine's nearest bucket,
+  runs the pre-compiled session, and resolves per-request futures;
+- graceful teardown: :meth:`drain` stops intake and completes everything
+  already accepted; :meth:`shutdown` additionally cancels (non-drain) and
+  joins the thread.
+
+Determinism for tests: with ``start=False`` no thread runs and
+:meth:`step` dispatches synchronously; combined with an injectable
+``clock`` the whole submit → deadline → dispatch → latency pipeline is
+exercised sleep-free (``tests/test_serve.py``). The threaded mode uses the
+same ``_pop_due`` core, so the sleep-free tests cover the real dispatch
+logic, not a test-only twin.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .metrics import ServeMetrics
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the bounded request queue is at capacity."""
+
+
+class _Request:
+    __slots__ = ("x", "n", "single", "future", "t_submit")
+
+    def __init__(self, x, n, single, future, t_submit):
+        self.x, self.n, self.single = x, n, single
+        self.future, self.t_submit = future, t_submit
+
+
+class DynamicBatcher:
+    """Thread-safe request queue + batching dispatcher over an
+    :class:`~dcnn_tpu.serve.engine.InferenceEngine`.
+
+    ``max_wait_ms`` trades tail latency for occupancy: 0 dispatches
+    whatever is queued the moment the dispatcher is free (lowest latency,
+    small batches at low load); a few ms lets concurrent arrivals coalesce
+    into fuller buckets. ``queue_capacity`` is in samples.
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 max_batch: Optional[int] = None, max_wait_ms: float = 2.0,
+                 queue_capacity: int = 128,
+                 metrics: Optional[ServeMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if queue_capacity < 1:
+            raise ValueError(f"queue_capacity must be >= 1, "
+                             f"got {queue_capacity}")
+        self.engine = engine
+        self.max_batch = min(max_batch or engine.max_batch, engine.max_batch)
+        self.max_wait_s = max_wait_ms / 1e3
+        self.queue_capacity = queue_capacity
+        self.metrics = metrics if metrics is not None else ServeMetrics(
+            clock=clock)
+        self._clock = clock
+        self._q: deque = deque()
+        self._rows = 0
+        self._cond = threading.Condition()
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"dcnn-serve-batcher-{engine.name}")
+            self._thread.start()
+
+    # -- intake --
+    def submit(self, x) -> Future:
+        """Enqueue one request — a single sample ``input_shape`` (future
+        resolves to ``(classes,)`` logits) or a small batch
+        ``(n, *input_shape)``, ``n <= max_batch`` (future resolves to
+        ``(n, classes)``). Raises :class:`QueueFullError` when the queue is
+        at capacity and ``RuntimeError`` after :meth:`drain`/
+        :meth:`shutdown`."""
+        x = np.asarray(x)
+        shp = self.engine.input_shape
+        single = x.shape == shp
+        if single:
+            x = x[None]
+        if x.ndim != len(shp) + 1 or x.shape[1:] != shp:
+            raise ValueError(f"expected {shp} or (n, *{shp}), "
+                             f"got shape {x.shape}")
+        n = x.shape[0]
+        if not 1 <= n <= self.max_batch:
+            raise ValueError(f"request batch {n} outside [1, "
+                             f"{self.max_batch}]; chunk it or use "
+                             f"engine.infer")
+        fut: Future = Future()
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("batcher is draining or shut down")
+            if self._rows + n > self.queue_capacity:
+                self.metrics.record_shed(n)
+                raise QueueFullError(
+                    f"queue at capacity ({self._rows}/{self.queue_capacity}"
+                    f" samples); request of {n} shed")
+            self._q.append(_Request(x, n, single, fut, self._clock()))
+            self._rows += n
+            self.metrics.record_submit(n)
+            self.metrics.record_queue_depth(self._rows)
+            self._cond.notify_all()
+        return fut
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return self._rows
+
+    # -- dispatch core (shared by the thread and the synchronous step) --
+    def _pop_due(self, force: bool) -> List[_Request]:
+        """Pop up to ``max_batch`` samples' worth of whole requests, but
+        only if a dispatch is due — queue full enough, oldest request past
+        its deadline, draining, or ``force``. Never splits a request."""
+        with self._cond:
+            if not self._q:
+                return []
+            due = (force or self._closing
+                   or self._rows >= self.max_batch
+                   or self._clock() >= self._q[0].t_submit + self.max_wait_s)
+            if not due:
+                return []
+            batch, rows = [], 0
+            while self._q and rows + self._q[0].n <= self.max_batch:
+                req = self._q.popleft()
+                self._rows -= req.n
+                # canonical Future handoff: claims the request for this
+                # batch, and drops one the caller cancelled while queued
+                # (set_result on it would otherwise poison the scatter)
+                if not req.future.set_running_or_notify_cancel():
+                    continue
+                rows += req.n
+                batch.append(req)
+            self.metrics.record_queue_depth(self._rows)
+            return batch
+
+    def _run(self, batch: List[_Request]) -> None:
+        try:
+            x = (batch[0].x if len(batch) == 1
+                 else np.concatenate([r.x for r in batch]))
+            rows = x.shape[0]
+            padded, _ = self.engine.pad_to_bucket(x)
+            # np.asarray materializes on host — a hard fence, so recorded
+            # latency covers the full compute, and scatter is cheap views
+            y = np.asarray(self.engine.run_padded(padded))
+            t_done = self._clock()
+            off = 0
+            for r in batch:
+                r.future.set_result(y[off] if r.single
+                                    else y[off:off + r.n])
+                self.metrics.record_done(t_done - r.t_submit, r.n)
+                off += r.n
+            self.metrics.record_batch(rows, padded.shape[0])
+        except Exception as e:  # scatter the failure, don't kill the thread
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def step(self, force: bool = True) -> int:
+        """Synchronously dispatch one batch (``start=False`` mode and
+        :meth:`drain`). ``force=False`` dispatches only if due — the hook
+        the fake-clock deadline tests drive. Returns requests served."""
+        batch = self._pop_due(force)
+        if batch:
+            self._run(batch)
+        return len(batch)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._closing:
+                    self._cond.wait()
+                if not self._q:  # closing and fully drained
+                    return
+                # hold for the batching window: until full, the oldest
+                # request's deadline, or drain (re-check the queue each
+                # wakeup — a concurrent step() call may have emptied it)
+                while (self._q and self._rows < self.max_batch
+                       and not self._closing):
+                    remaining = (self._q[0].t_submit + self.max_wait_s
+                                 - self._clock())
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            batch = self._pop_due(force=True)
+            if batch:
+                self._run(batch)
+
+    # -- teardown --
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting new requests; complete everything accepted.
+        Threaded mode joins the dispatcher (it exits once empty);
+        ``start=False`` mode dispatches the backlog inline."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(f"drain did not finish in {timeout}s")
+            self._thread = None
+        else:
+            while self.step(force=True):
+                pass
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """``drain=True``: :meth:`drain`. ``drain=False``: reject further
+        intake and cancel queued requests (their futures raise
+        ``CancelledError``)."""
+        if drain:
+            self.drain(timeout)
+            return
+        with self._cond:
+            self._closing = True
+            pending = list(self._q)
+            self._q.clear()
+            self._rows = 0
+            self.metrics.record_queue_depth(0)
+            self._cond.notify_all()
+        for r in pending:
+            r.future.cancel()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    def __repr__(self) -> str:
+        return (f"DynamicBatcher(engine={self.engine.name!r}, "
+                f"max_batch={self.max_batch}, "
+                f"max_wait_ms={self.max_wait_s * 1e3:g}, "
+                f"capacity={self.queue_capacity})")

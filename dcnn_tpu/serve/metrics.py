@@ -1,0 +1,126 @@
+"""Serving metrics: rolling latency percentiles, queue depth, batch
+occupancy, throughput, shed accounting.
+
+The one rule that shapes this module: every timestamp comes from an
+**injectable clock** (``clock=``, default ``time.monotonic``). The serve
+test suite passes a fake clock and advances it by hand, so latency
+assertions are exact equalities and tier-1 runs sleep-free; the live
+batcher and the bench pass nothing and get wall time. (Same motive as the
+reference's ``Matrix`` profiling maps being plain data — measurement that
+can be driven deterministically is measurement that can be tested.)
+
+All recorders are thread-safe (the batcher's dispatcher thread and many
+submitter threads hit them concurrently) and O(1); ``snapshot()`` does the
+O(window log window) percentile sort, once, on the caller's thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+
+class ServeMetrics:
+    """Rolling serving statistics exported as a plain dict.
+
+    ``window`` bounds the latency/occupancy deques — percentiles describe
+    the last ``window`` completed requests, not all of history, so a load
+    spike ages out instead of polluting the p99 forever. Counters
+    (submitted / completed / shed) are cumulative since construction or
+    :meth:`reset`.
+    """
+
+    def __init__(self, *, window: int = 4096,
+                 clock: Callable[[], float] = time.monotonic):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._clock = clock
+        self._window = window
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Zero every counter and restart the throughput wall-clock."""
+        with self._lock:
+            self._lat_s: deque = deque(maxlen=self._window)
+            self._occ: deque = deque(maxlen=self._window)
+            self._submitted = 0
+            self._completed = 0
+            self._shed = 0
+            self._batches = 0
+            self._queue_depth = 0
+            self._t0 = self._clock()
+
+    # -- recorders (all O(1), thread-safe) --
+    def record_submit(self, n: int = 1) -> None:
+        """A request of ``n`` samples was accepted into the queue."""
+        with self._lock:
+            self._submitted += n
+
+    def record_shed(self, n: int = 1) -> None:
+        """A request of ``n`` samples was rejected by backpressure."""
+        with self._lock:
+            self._shed += n
+
+    def record_queue_depth(self, depth: int) -> None:
+        """Gauge: samples currently queued (set on enqueue and dispatch)."""
+        with self._lock:
+            self._queue_depth = depth
+
+    def record_batch(self, size: int, bucket: int) -> None:
+        """A batch of ``size`` real samples ran in a ``bucket``-sized
+        session; occupancy = size/bucket (the padding waste indicator)."""
+        with self._lock:
+            self._batches += 1
+            self._occ.append(size / max(bucket, 1))
+
+    def record_done(self, latency_s: float, n: int = 1) -> None:
+        """A request of ``n`` samples completed ``latency_s`` after it was
+        submitted (queue wait + batching delay + compute)."""
+        with self._lock:
+            self._completed += n
+            self._lat_s.append(latency_s)
+
+    # -- export --
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """Point-in-time view. Latency keys are ``None`` until the first
+        completion so a consumer can't mistake 'no data' for 'zero ms'."""
+        with self._lock:
+            lat = sorted(self._lat_s)
+            occ = list(self._occ)
+            submitted, completed = self._submitted, self._completed
+            shed, batches = self._shed, self._batches
+            depth = self._queue_depth
+            wall_s = max(self._clock() - self._t0, 0.0)
+
+        def pct(q: float) -> Optional[float]:
+            if not lat:
+                return None
+            # nearest-rank on the sorted window; exact for the fake-clock
+            # tests, standard for live traffic
+            i = min(int(q * (len(lat) - 1) + 0.5), len(lat) - 1)
+            return lat[i] * 1e3
+
+        offered = submitted + shed
+        return {
+            "requests_submitted": submitted,
+            "requests_completed": completed,
+            "requests_shed": shed,
+            "shed_fraction": (shed / offered) if offered else 0.0,
+            "queue_depth": depth,
+            "batches": batches,
+            "batch_occupancy": (sum(occ) / len(occ)) if occ else None,
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "mean_ms": (sum(lat) / len(lat) * 1e3) if lat else None,
+            "throughput_rps": (completed / wall_s) if wall_s > 0 else None,
+            "wall_s": wall_s,
+        }
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        return (f"ServeMetrics(completed={s['requests_completed']}, "
+                f"shed={s['requests_shed']}, p99_ms={s['p99_ms']})")

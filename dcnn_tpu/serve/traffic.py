@@ -1,0 +1,48 @@
+"""Open-loop traffic generation for serving measurement.
+
+One pacing loop, shared by every measurement surface
+(``examples/serve_snapshot.py``, ``bench.py serve_section``, the real-time
+soak test) so the load they report is generated identically.
+
+Open-loop means arrivals follow the offered rate regardless of
+completions — the honest way to measure an overloaded server: a closed
+loop self-throttles to whatever the server sustains and hides exactly the
+queue growth that load shedding exists to bound. When the generator falls
+behind schedule (a slow ``submit`` or scheduler hiccup) it does not sleep
+until it has caught back up, preserving the offered average rate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Sequence, Tuple
+
+from .batcher import DynamicBatcher, QueueFullError
+
+
+def open_loop(batcher: DynamicBatcher, samples: Sequence, offered_rps: float,
+              seconds: float, *, clock: Callable[[], float] = time.monotonic,
+              sleep: Callable[[float], None] = time.sleep
+              ) -> List[Tuple[int, "object"]]:
+    """Submit single-sample requests from ``samples`` (cycled) at a fixed
+    offered rate for ``seconds``. Returns ``[(sample_index, future), ...]``
+    for every accepted request; shed requests are counted by the batcher's
+    metrics. ``clock``/``sleep`` are injectable like everywhere else in
+    the serve stack."""
+    if offered_rps <= 0:
+        raise ValueError(f"offered_rps must be > 0, got {offered_rps}")
+    futs: List[Tuple[int, object]] = []
+    t0 = clock()
+    t_next, i = t0, 0
+    while t_next < t0 + seconds:
+        dt = t_next - clock()
+        if dt > 0:
+            sleep(dt)
+        k = i % len(samples)
+        try:
+            futs.append((k, batcher.submit(samples[k])))
+        except QueueFullError:
+            pass  # shed — the valve working as designed
+        i += 1
+        t_next += 1.0 / offered_rps
+    return futs

@@ -1,0 +1,121 @@
+"""Online serving driver: the committed digits28 snapshot behind the
+dynamic batcher, under synthetic open-loop traffic.
+
+The deployment-shaped counterpart to ``evaluate_snapshot.py``: that driver
+proves the fold/int8/export transforms offline; this one puts the same
+snapshot **online** — ``dcnn_tpu.serve.InferenceEngine`` (bucketed
+pre-compiled sessions) behind a ``DynamicBatcher`` (bounded queue, batching
+window, load shedding) — and prints the latency/throughput/occupancy table
+per offered load, plus the top-1 accuracy of everything actually served
+(batching must not change answers).
+
+Traffic is open-loop (arrivals at the offered rate regardless of
+completions — the honest way to measure an overloaded server: a closed
+loop self-throttles and hides the queue growth that shedding exists for).
+
+Usage:
+    python examples/serve_snapshot.py [snapshot_dir]
+
+Env knobs: ``INT8=1`` serves the int8 PTQ graph (calibrated on the train
+split — never the measured one); ``SERVE_LOADS`` comma-separated offered
+rps (default "100,300,900"); ``SERVE_SECONDS`` traffic window per load
+point (default 2.0); ``SERVE_MAX_BATCH`` (default 16), ``SERVE_WAIT_MS``
+batching window (default 2.0), ``SERVE_QUEUE`` queue capacity in samples
+(default 4x max batch).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from common import setup
+
+import numpy as np
+
+import dcnn_tpu  # noqa: F401  (platform override side effects)
+
+from dcnn_tpu.data import MNISTDataLoader
+from dcnn_tpu.serve import DynamicBatcher, InferenceEngine, ServeMetrics
+from dcnn_tpu.serve import open_loop as run_open_loop
+from dcnn_tpu.train import load_checkpoint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    setup("serve_snapshot")
+    snap = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        ROOT, "model_snapshots", "mnist_cnn_model")
+
+    import accuracy_gates
+    csv_dir = accuracy_gates.ensure_digits28_csvs()
+
+    model, params, state, _, _, meta = load_checkpoint(snap)
+    fmt = "NCHW" if model.input_shape[0] <= model.input_shape[-1] else "NHWC"
+    val = MNISTDataLoader(os.path.join(csv_dir, "test.csv"), data_format=fmt,
+                          batch_size=256, shuffle=False, drop_last=False)
+    val.load_data()
+    xs, ys = [], []
+    for xb, yb in val:
+        xs.append(np.asarray(xb))
+        ys.append(np.asarray(yb))
+    samples = np.concatenate(xs)
+    labels = np.concatenate(ys).argmax(-1)
+
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", "16"))
+    wait_ms = float(os.environ.get("SERVE_WAIT_MS", "2.0"))
+    qcap = int(os.environ.get("SERVE_QUEUE", str(4 * max_batch)))
+    int8 = os.environ.get("INT8", "0") == "1"
+
+    if int8:
+        # calibrate on the TRAIN split — never the one accuracy is
+        # reported on (same discipline as evaluate_snapshot.py)
+        cal = MNISTDataLoader(os.path.join(csv_dir, "train.csv"),
+                              data_format=fmt, batch_size=512,
+                              shuffle=False, drop_last=False)
+        cal.load_data()
+        calib = np.asarray(next(iter(cal))[0])
+    t0 = time.perf_counter()
+    engine = InferenceEngine.from_model(
+        model, params, state, int8_calib=calib if int8 else None,
+        max_batch=max_batch)
+    print(f"engine: {engine} (metadata {meta})")
+    print(f"  sessions compiled+warm in {time.perf_counter() - t0:.2f}s: "
+          + ", ".join(f"b{b}={st['compile_s']:.2f}s"
+                      for b, st in engine.compile_stats.items()))
+
+    loads = [float(v) for v in
+             os.environ.get("SERVE_LOADS", "100,300,900").split(",")]
+    seconds = float(os.environ.get("SERVE_SECONDS", "2.0"))
+
+    print(f"\nopen-loop traffic: {seconds:.1f}s per point, max_wait "
+          f"{wait_ms:g} ms, queue {qcap} samples "
+          f"({'int8' if int8 else 'folded float'} graph)")
+    hdr = (f"{'offered rps':>12} {'achieved rps':>13} {'p50 ms':>8} "
+           f"{'p95 ms':>8} {'p99 ms':>8} {'occupancy':>10} {'shed':>7} "
+           f"{'top-1':>7}")
+    print(hdr)
+    print("-" * len(hdr))
+    for rps in loads:
+        metrics = ServeMetrics()
+        batcher = DynamicBatcher(engine, max_wait_ms=wait_ms,
+                                 queue_capacity=qcap, metrics=metrics)
+        futs = run_open_loop(batcher, samples, rps, seconds)
+        batcher.drain(timeout=120)
+        s = metrics.snapshot()
+        hits = sum(int(np.asarray(f.result()).argmax() == labels[i])
+                   for i, f in futs)
+        acc = hits / len(futs) if futs else float("nan")
+        print(f"{rps:>12.0f} {s['throughput_rps']:>13.1f} "
+              f"{s['p50_ms']:>8.2f} {s['p95_ms']:>8.2f} {s['p99_ms']:>8.2f} "
+              f"{s['batch_occupancy']:>10.2f} "
+              f"{s['shed_fraction']:>6.1%} {acc:>7.4f}")
+        if acc == acc and acc < 0.98:  # batching must not change answers
+            raise SystemExit(f"served accuracy {acc} below gate at "
+                             f"{rps} rps")
+
+
+if __name__ == "__main__":
+    main()

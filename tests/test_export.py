@@ -156,3 +156,50 @@ def test_export_requires_input_shape():
 
     with pytest.raises(ValueError, match="input_shape"):
         export_inference(Sequential([], name="noshape"), (), ())
+
+
+def test_load_inference_jits_and_caches():
+    """load_inference returns a jitted callable: a second same-shape call
+    must be served from the compile cache (cache size stays 1), not
+    re-traced — the property the serving engine's session reuse rests on."""
+    model = _small_model()
+    ts = _train_a_bit(model)
+    f = load_inference(export_inference(model, ts.params, ts.state))
+    x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    f(x)
+    assert f._cache_size() == 1
+    f(x)
+    assert f._cache_size() == 1  # second call hit the cache
+    f(jnp.zeros((4, 8, 8, 3), jnp.float32))
+    assert f._cache_size() == 2  # new shape = new entry, old one kept
+
+
+def test_roundtrip_bit_identical_at_every_serve_bucket():
+    """Folded and int8 graphs round-trip through export_inference/
+    load_inference at every serve bucket size with BIT-IDENTICAL logits vs
+    the live model at the same batch shape: serialization must not perturb
+    the program (same StableHLO, same backend, same compile), at any
+    bucket a serving engine will ever run. The live side is the *jitted*
+    forward — what export traces and what serving executes; op-by-op eager
+    dispatch compiles each op separately and can tile fp32 reductions
+    differently (observed at batch 1 on CPU), which is an eager-vs-compiled
+    artifact, not an export infidelity."""
+    from dcnn_tpu.serve import serve_buckets
+
+    model = _small_model()
+    ts = _train_a_bit(model)
+    calib = jnp.asarray(np.random.default_rng(7).normal(
+        size=(16, 8, 8, 3)).astype(np.float32))
+    fmodel, fp, fs = fold_batchnorm(model, ts.params, ts.state)
+    qmodel, qp, qs = quantize_model(model, ts.params, ts.state, calib)
+    rng = np.random.default_rng(8)
+    for tag, (m, p, s) in (("folded", (fmodel, fp, fs)),
+                           ("int8", (qmodel, qp, qs))):
+        f = load_inference(export_inference(m, p, s))
+        live_fn = jax.jit(
+            lambda x, m=m, p=p, s=s: m.apply(p, s, x, training=False)[0])
+        for b in serve_buckets(8):
+            x = jnp.asarray(rng.normal(size=(b, 8, 8, 3)).astype(np.float32))
+            live = np.asarray(live_fn(x))
+            art = np.asarray(f(x))
+            assert np.array_equal(art, live), (tag, b)
