@@ -45,7 +45,13 @@ BENCH_MATRIX=1 for the layout/dtype sweep, BENCH_RESIDENT_SAMPLES
 jax.profiler trace, BENCH_SERVE=1 for the online-serving
 latency-vs-offered-load curve (dcnn_tpu/serve/; knobs
 BENCH_SERVE_LOADS/_SECONDS/_MAX_BATCH/_WAIT_MS/_QUEUE/_INT8 — emitted
-under a "serving" key).
+under a "serving" key), BENCH_OBS=1 to enable the unified tracer
+(dcnn_tpu/obs/) for the whole run — writes a Chrome trace_event artifact
+(BENCH_OBS_TRACE, default /tmp/dcnn_bench_trace.json; open in Perfetto:
+training step spans on the "train" track, per-chunk H2D gather/put spans
+on the transfer-thread tracks, serve spans under BENCH_SERVE=1) and
+appends a "telemetry" block (trace path, span counts, metrics-registry
+snapshot) to the JSON line. See docs/observability.md.
 """
 
 from __future__ import annotations
@@ -111,12 +117,17 @@ def _measure(step, ts, x, y, key, steps, reps):
     import jax
 
     from dcnn_tpu.core.fence import hard_fence
+    from dcnn_tpu.obs import get_tracer
 
+    tracer = get_tracer()  # no-op spans unless BENCH_OBS=1 enabled it
     rep_times = []
     for r in range(reps):
         t0 = time.perf_counter()
         for i in range(steps):
-            ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
+            # dispatch-side span (~0.4 µs disabled, sub-µs enabled, vs
+            # multi-ms dispatches — timing impact is noise)
+            with tracer.span("train.step", track="train", rep=r, step=i):
+                ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
         hard_fence(loss)
         rep_times.append(time.perf_counter() - t0)
     return min(rep_times), ts, rep_times
@@ -512,9 +523,19 @@ def serve_section(data_format, engine=None, loads=None, seconds=None):
     qcap = int(os.environ.get("BENCH_SERVE_QUEUE",
                               str(4 * engine.max_batch)))
 
+    # under BENCH_OBS=1 the serve counters must land in the process-global
+    # registry or the telemetry block would silently omit the serve_*
+    # series it promises; points stay separable via their own snapshots
+    # (per-instance state), the registry carries the cumulative run
+    obs_reg = None
+    if os.environ.get("BENCH_OBS", "0") == "1":
+        from dcnn_tpu.obs import get_registry
+        obs_reg = get_registry()
+
     points = []
     for rps in loads:
-        metrics = ServeMetrics()
+        metrics = (ServeMetrics(registry=obs_reg) if obs_reg is not None
+                   else ServeMetrics())
         batcher = DynamicBatcher(engine, max_wait_ms=wait_ms,
                                  queue_capacity=qcap, metrics=metrics)
         open_loop(batcher, pool, rps, seconds)
@@ -549,6 +570,15 @@ def main() -> None:
 
     from dcnn_tpu.utils import enable_compile_cache
     enable_compile_cache()
+
+    obs_on = os.environ.get("BENCH_OBS", "0") == "1"
+    if obs_on:
+        # enable BEFORE any instrumented section so engine compile spans,
+        # feed spans, and train steps all land on one timeline
+        from dcnn_tpu.obs import configure
+        configure(enabled=True,
+                  capacity=int(os.environ.get("BENCH_OBS_CAPACITY",
+                                              "262144")))
 
     root = os.path.dirname(os.path.abspath(__file__))
     # batch 2048 default, re-measured in r5 (26.2-26.5k img/s / 43.4-43.9%
@@ -674,6 +704,20 @@ def main() -> None:
                     "img_per_sec": round(ips, 1), "tflops": round(tf, 2)}
         set_precision(precision)
         out["matrix"] = matrix
+
+    if obs_on:
+        from dcnn_tpu.obs import get_registry, get_tracer
+
+        tracer = get_tracer()
+        trace_path = os.environ.get("BENCH_OBS_TRACE",
+                                    "/tmp/dcnn_bench_trace.json")
+        tracer.export_chrome(trace_path)
+        out["telemetry"] = {
+            "trace_file": trace_path,
+            "events": len(tracer),
+            "spans": tracer.span_counts(),
+            "metrics": get_registry().snapshot(),
+        }
 
     print(json.dumps(out))
 

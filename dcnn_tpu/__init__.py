@@ -34,6 +34,6 @@ if _get_env("DCNN_DEBUG", False):
 
     _edm()
 
-from . import core, nn, ops, optim
+from . import core, nn, obs, ops, optim
 
-__all__ = ["core", "nn", "ops", "optim", "__version__"]
+__all__ = ["core", "nn", "obs", "ops", "optim", "__version__"]

@@ -1,0 +1,28 @@
+"""Version-compat shims for fast-moving jax APIs.
+
+The repo targets current jax (``jax.shard_map`` with ``check_vma=``), but
+CI hosts and the CPU test container may carry an older release where the
+same functionality lives at ``jax.experimental.shard_map.shard_map`` with
+the kwarg spelled ``check_rep=``. Every internal ``shard_map`` call goes
+through this module so the whole parallel/data stack imports (and runs)
+on both — one shim instead of four scattered try/excepts.
+"""
+
+from __future__ import annotations
+
+try:  # current jax
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the ``check_vma``/``check_rep`` kwarg rename
+    papered over. ``check_vma=None`` leaves the library default."""
+    kw = {} if check_vma is None else {_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

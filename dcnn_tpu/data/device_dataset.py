@@ -276,9 +276,9 @@ def make_resident_epoch_dp(model, loss_fn: Callable, optimizer, *,
     where x_shard/y_shard are sharded [N, ...]/[N] arrays (use
     :func:`stage_sharded`). ``ts`` is replicated.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from ..core.compat import shard_map
     from ..core.mesh import DATA_AXIS
     from ..core.precision import get_compute_dtype
     from ..train.trainer import make_train_step

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import native
+from ..obs import get_tracer
 from .transfer import TransferEngine
 
 
@@ -255,7 +256,12 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                 raise item
             i, sx, sy, stats, put_done_t = item
             t4 = time.perf_counter()
-            ts, loss = step(ts, sx, sy, jax.random.fold_in(rng, i), lr)
+            # dispatch span (async XLA: issue wall, not device compute —
+            # the h2d.* spans from the engine's fenced pool threads carry
+            # the device-true feed side)
+            with get_tracer().span("train.shard_dispatch", track="train",
+                                   shard=i):
+                ts, loss = step(ts, sx, sy, jax.random.fold_in(rng, i), lr)
             t5 = time.perf_counter()
             losses.append(loss)
             if timeline is not None:

@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import native
 from ..core.fence import hard_fence
+from ..obs import get_registry, get_tracer
 
 
 def chunk_bounds(n: int, num_chunks: int) -> List[Tuple[int, int]]:
@@ -148,6 +149,19 @@ class TransferEngine:
         self._lock = threading.Lock()
         self._inflight = 0
         self._closed = False
+        # registry instruments hoisted: fixed names, resolved once — the
+        # per-shipment path only touches the instruments' own O(1) ops
+        reg = get_registry()
+        self._m_bytes = reg.counter("h2d_bytes_total",
+                                    "bytes shipped host->device")
+        self._m_chunks = reg.counter("h2d_chunks_total",
+                                     "chunk transfers issued")
+        self._m_put_s = reg.histogram("h2d_put_seconds",
+                                      "per-shipment union of put spans")
+        self._m_inflight = reg.gauge("h2d_inflight_max",
+                                     "peak concurrent puts, last shipment")
+        self._m_gbps = reg.gauge("h2d_gbps",
+                                 "effective H2D rate, last shipment")
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -166,20 +180,33 @@ class TransferEngine:
                     t_base: float, peak: list):
         """One pool task: gather rows [lo, hi) (of ``sel`` when given, of
         ``arr`` itself otherwise) and push them through their own
-        ``device_put``. Returns (device_chunk, span_dict)."""
+        ``device_put``. Returns (device_chunk, span_dict).
+
+        Each phase is also a tracer span (``h2d.gather`` / ``h2d.put``,
+        ``dcnn_tpu.obs``): the pool threads give each in-flight chunk its
+        own labeled track in the Chrome trace, so transfer overlap is
+        *visible*, not just summarized by ``inflight_max``. The local
+        span-dict bookkeeping stays — ``inflight_max``/``h2d_gbps`` are
+        derived per shipment from it and must work with tracing off."""
+        tracer = get_tracer()
         t0 = time.perf_counter()
-        if sel is not None:
-            part = native.gather_rows(arr, sel[lo:hi])
-        else:
-            part = arr[lo:hi]  # contiguous view — no host copy
+        with tracer.span("h2d.gather", chunk=k, rows=hi - lo):
+            if sel is not None:
+                part = native.gather_rows(arr, sel[lo:hi])
+            else:
+                part = arr[lo:hi]  # contiguous view — no host copy
         t1 = time.perf_counter()
         with self._lock:
             self._inflight += 1
             peak[0] = max(peak[0], self._inflight)
         try:
-            d = jax.device_put(part, self._device)
-            if self.fence:
-                hard_fence(d)
+            # fenced on this pool thread, so the span measures the actual
+            # transfer, not async dispatch (module docstring / fence=)
+            with tracer.span("h2d.put", chunk=k, rows=hi - lo,
+                             bytes=int(part.nbytes)):
+                d = jax.device_put(part, self._device)
+                if self.fence:
+                    hard_fence(d)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -253,21 +280,45 @@ class TransferEngine:
         per-chunk spans / ``inflight_max`` / effective ``h2d_gbps``."""
         t_base = time.perf_counter() if t_base is None else t_base
         t_call0 = time.perf_counter()
-        peak = [0]
-        futs = self._submit(x, sel, t_base, peak)
-        dy = None
-        if y is not None:
-            yy = y if sel is None else native.gather_rows(y, sel)
-            dy = jax.device_put(yy, self._device)
-            if self.fence:
-                hard_fence(dy)
-        chunks, spans = self._collect(futs)
-        if self.reassemble == "concat":
-            dx = chunks[0] if len(chunks) == 1 else _device_concat(chunks)
-        else:
-            dx = chunks
-        wall = time.perf_counter() - t_call0
-        return dx, dy, self._stats(spans, peak[0], wall)
+        tracer = get_tracer()
+        shard_span = tracer.begin("h2d.shard", track="h2d",
+                                  rows=int(sel.shape[0] if sel is not None
+                                           else x.shape[0]))
+        try:
+            peak = [0]
+            futs = self._submit(x, sel, t_base, peak)
+            dy = None
+            if y is not None:
+                with tracer.span("h2d.put_labels", track="h2d"):
+                    yy = y if sel is None else native.gather_rows(y, sel)
+                    dy = jax.device_put(yy, self._device)
+                    if self.fence:
+                        hard_fence(dy)
+            chunks, spans = self._collect(futs)
+            if self.reassemble == "concat":
+                dx = (chunks[0] if len(chunks) == 1
+                      else _device_concat(chunks))
+            else:
+                dx = chunks
+            wall = time.perf_counter() - t_call0
+            stats = self._stats(spans, peak[0], wall)
+        except BaseException as e:
+            # close the cross-thread span on the failure path too (incl.
+            # reassembly OOM) — the shipment being debugged must not be
+            # the one missing from the trace
+            tracer.end(shard_span, error=type(e).__name__)
+            raise
+        tracer.end(shard_span, bytes=stats["bytes"],
+                   inflight_max=stats["inflight_max"])
+        # shared-registry rollups: the cumulative cross-shipment view the
+        # per-call stats dict cannot give (docs/observability.md)
+        self._m_bytes.inc(stats["bytes"])
+        self._m_chunks.inc(len(spans))
+        self._m_put_s.observe(stats["put_s"])
+        self._m_inflight.set(stats["inflight_max"])
+        if stats["h2d_gbps"] is not None:
+            self._m_gbps.set(stats["h2d_gbps"])
+        return dx, dy, stats
 
     def put_array(self, arr: np.ndarray):
         """Ship one array chunk-pipelined and return a SINGLE device array

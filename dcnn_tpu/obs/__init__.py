@@ -1,0 +1,40 @@
+"""Unified observability: one metrics registry + one span tracer for the
+whole framework.
+
+Before this subsystem the repo had three disconnected measurement surfaces
+— ``train/profiling.py`` (per-layer fenced µs tables), ``serve/metrics.py``
+(rolling serving percentiles), and the hand-rolled ``streaming_timeline``
+stats in ``bench.py`` / ``data/transfer.py`` — each speaking its own
+format. ``dcnn_tpu.obs`` is the shared layer they now report through:
+
+- :mod:`~dcnn_tpu.obs.registry` — thread-safe Counter / Gauge / Histogram
+  (fixed log-spaced buckets), O(1) recorders, ``snapshot()`` dict export
+  and Prometheus text exposition; :func:`get_registry` is the
+  process-global instance.
+- :mod:`~dcnn_tpu.obs.tracer` — structured span tracing
+  (``span("h2d.put", chunk=i)`` context manager, explicit
+  ``begin``/``end`` for cross-thread spans), bounded ring buffer,
+  exporters to JSONL and Chrome ``trace_event`` JSON (Perfetto-loadable,
+  labeled tracks); :func:`get_tracer` is the process-global instance —
+  a no-op (< 100 ns/span, asserted in tests) until enabled via
+  :func:`configure` or ``DCNN_TRACE=1``.
+
+Instrumented out of the box: ``Trainer`` epochs/steps/eval,
+``data/transfer.py`` per-chunk H2D gathers+puts, the host-driven pipeline
+(one track per stage) and compiled-pipeline dispatches, and the serving
+stack's enqueue → dispatch → infer decomposition. ``BENCH_OBS=1 python
+bench.py`` writes the Chrome trace artifact and embeds a telemetry block
+in the bench JSON. Workflow guide: ``docs/observability.md``.
+
+This package is stdlib-only (no jax import) — safe to import from any
+layer, including before backend selection.
+"""
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry)
+from .tracer import Tracer, configure, get_tracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "Tracer", "configure", "get_tracer",
+]

@@ -1,0 +1,306 @@
+"""Structured span tracing with a bounded ring buffer and Chrome-trace export.
+
+The profiling story so far is per-layer µs tables (``train/profiling.py``)
+and per-shard stats dicts (``data/transfer.py``) — numbers with no common
+timeline. This tracer gives every subsystem one: a **span** is a named
+``[t0, t1)`` interval with attributes, recorded on a **track** (a labeled
+row in the viewer — one per pipeline stage, one per transfer thread, one
+for the serve queue), and the whole event store exports to
+
+- **JSONL** (one event per line — greppable, streamable), and
+- **Chrome ``trace_event`` format** — a single JSON file Perfetto /
+  ``chrome://tracing`` loads directly, with ``thread_name`` metadata so
+  tracks appear labeled, not as anonymous tids.
+
+Design constraints, in order:
+
+1. **Disabled must be free.** ``get_tracer()`` is called on hot paths
+   (per H2D chunk, per serve request, per pipeline microbatch). When
+   tracing is off, ``span``/``begin``/``end``/``instant`` are swapped for
+   module-level no-op *functions* (not methods — no ``self`` binding, no
+   kwargs repack beyond the call itself): < 100 ns per span on a
+   current CPython, asserted by ``tests/test_obs.py``.
+2. **Bounded memory.** Events land in a ``deque(maxlen=capacity)`` — the
+   ring buffer drops the OLDEST events under pressure, so a tracer left
+   enabled for a week of serving costs a fixed few MB, never an OOM.
+   ``deque.append`` is a single C-level op (GIL-atomic), so recording
+   needs no lock and concurrent spans are never lost or torn.
+3. **Injectable clock** (the ``ServeMetrics`` rule): tests pass a fake
+   clock and assert span timestamps/durations by exact equality.
+4. **Cross-thread spans.** The ``span()`` context manager covers the
+   begin/end-on-one-thread case; ``begin()``/``end()`` return/consume an
+   explicit handle for intervals that OPEN on one thread and CLOSE on
+   another (a serve request enqueued by a submitter thread, dispatched by
+   the batcher thread). The handle carries its track, so the event lands
+   on the row of the *operation*, not whichever thread happened to end it.
+
+Spans record **host-side intervals**. Around an async XLA dispatch a span
+measures dispatch wall, not device compute — call sites that fence
+(transfer-engine puts, sampled pipeline stages) get device-true spans, the
+rest are annotated as dispatch spans in their name/attrs. That is the same
+honesty line the rest of the repo draws (core/fence.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+class _NullSpan:
+    """Singleton no-op span/handle: context manager, ``set()`` sink."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _null_span(name, **attrs):
+    """Disabled-path ``span``/``begin``/``instant``: a plain module-level
+    function (the cheapest callable CPython has — no bound-method alloc)
+    returning the shared null span."""
+    return _NULL_SPAN
+
+
+def _null_end(handle, **attrs):
+    return None
+
+
+class _Span:
+    """Live span: context-manager for same-thread use, explicit handle for
+    cross-thread ``begin``/``end``. ``track`` pins the display row; default
+    is the recording thread's name."""
+
+    __slots__ = ("_tracer", "name", "track", "attrs", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, track: Optional[str],
+                 attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.track = track
+        self.attrs = attrs
+        self.t0 = tracer._clock()
+
+    def set(self, **attrs) -> "_Span":
+        """Attach attributes mid-span (e.g. bytes known only after the
+        gather)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        # re-stamp: construction may predate entry (begin() handles are
+        # stamped at begin, but `with tracer.span(...)` should measure the
+        # block, not the call)
+        self.t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._record(self)
+        return False
+
+
+class Tracer:
+    """Span recorder over a bounded ring buffer.
+
+    ``enabled=False`` (the default for the process-global instance) swaps
+    every recording entry point for a no-op function; ``set_enabled(True)``
+    swaps the real ones back in. The swap is per-instance attribute
+    assignment, so call sites holding the tracer object observe the change
+    immediately and pay zero branching when disabled.
+    """
+
+    def __init__(self, *, capacity: int = 65536,
+                 clock: Callable[[], float] = time.perf_counter,
+                 enabled: bool = False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._clock = clock
+        self._epoch = clock()
+        self._events: deque = deque(maxlen=capacity)
+        self.capacity = capacity
+        self.set_enabled(enabled)
+
+    # -- enable/disable ----------------------------------------------------
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = bool(on)
+        if self.enabled:
+            self.span = self._span
+            self.begin = self._span  # same stamped handle, no CM entry needed
+            self.end = self._end
+            self.instant = self._instant
+        else:
+            self.span = _null_span
+            self.begin = _null_span
+            self.end = _null_end
+            self.instant = _null_span
+
+    # -- recording (real implementations) ----------------------------------
+    def _span(self, name: str, *, track: Optional[str] = None,
+              **attrs) -> _Span:
+        return _Span(self, name, track, attrs)
+
+    def _end(self, handle: _Span, **attrs) -> None:
+        """Close a ``begin()`` handle (cross-thread safe). Ending the null
+        handle (begun while disabled) is a no-op, so an enable/disable flip
+        mid-span never raises."""
+        if handle is _NULL_SPAN or handle is None:
+            return
+        if attrs:
+            handle.attrs.update(attrs)
+        self._record(handle)
+
+    def _instant(self, name: str, *, track: Optional[str] = None, **attrs):
+        t = self._clock()
+        self._events.append(
+            (name, t - self._epoch, None,
+             track if track is not None else threading.current_thread().name,
+             attrs))
+        return _NULL_SPAN
+
+    def _record(self, span: _Span) -> None:
+        t1 = self._clock()
+        track = (span.track if span.track is not None
+                 else threading.current_thread().name)
+        # one GIL-atomic append — concurrent recorders never lose or tear
+        # an event, and maxlen evicts the oldest under pressure
+        self._events.append(
+            (span.name, span.t0 - self._epoch, t1 - span.t0, track,
+             span.attrs))
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def _events_list(self) -> list:
+        """Reader-side copy of the ring buffer. ``list(deque)`` is one
+        C-level call (atomic under the CPython GIL), but that is an
+        implementation detail — retry on the 'deque mutated during
+        iteration' RuntimeError so a live-recording tracer can always be
+        exported mid-run (serving soaks export while request threads
+        record)."""
+        for _ in range(8):
+            try:
+                return list(self._events)
+            except RuntimeError:  # concurrent append won the race; retry
+                continue
+        return list(self._events)  # last attempt unguarded: surface the bug
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Copy of the buffer as dicts, oldest first. ``ts_s`` is seconds
+        since the tracer epoch; ``dur_s`` is None for instant events."""
+        return [{"name": n, "ts_s": ts, "dur_s": dur, "track": track,
+                 "args": dict(attrs)}
+                for (n, ts, dur, track, attrs) in self._events_list()]
+
+    def clear(self) -> None:
+        self._events.clear()
+        self._epoch = self._clock()
+
+    def span_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for (n, *_rest) in self._events_list():
+            counts[n] = counts.get(n, 0) + 1
+        return counts
+
+    # -- exporters ---------------------------------------------------------
+    def export_jsonl(self, path: str) -> str:
+        """One JSON object per line per event."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev) + "\n")
+        return path
+
+    def export_chrome(self, path: str) -> str:
+        """Chrome ``trace_event`` JSON (Perfetto / chrome://tracing).
+
+        Complete spans become ``ph:"X"`` events (µs timestamps); instants
+        become ``ph:"i"``. Each distinct track maps to a stable tid
+        (first-seen order) with a ``thread_name`` metadata record, so the
+        viewer shows labeled rows — "stage0", "h2d-xfer_0", "serve" — not
+        anonymous thread ids."""
+        evs = self._events_list()
+        tids: Dict[str, int] = {}
+        out: List[Dict[str, Any]] = [{
+            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+            "args": {"name": "dcnn_tpu"}}]
+        for (_n, _ts, _dur, track, _a) in evs:
+            if track not in tids:
+                tids[track] = len(tids) + 1
+                out.append({"ph": "M", "pid": 1, "tid": tids[track],
+                            "name": "thread_name",
+                            "args": {"name": track}})
+        for (name, ts, dur, track, attrs) in evs:
+            ev: Dict[str, Any] = {
+                "name": name, "pid": 1, "tid": tids[track],
+                "ts": round(ts * 1e6, 3), "cat": name.split(".", 1)[0],
+                "args": {k: _json_safe(v) for k, v in attrs.items()},
+            }
+            if dur is None:
+                ev["ph"] = "i"
+                ev["s"] = "t"   # thread-scoped instant
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = round(dur * 1e6, 3)
+            out.append(ev)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        return path
+
+
+def _json_safe(v):
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+# -- process-global tracer -------------------------------------------------
+_GLOBAL_TRACER = Tracer(
+    enabled=os.environ.get("DCNN_TRACE", "0") == "1")
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer every built-in call site records through.
+    Disabled by default (no-op entry points, < 100 ns/span); enable with
+    :func:`configure` or ``DCNN_TRACE=1``."""
+    return _GLOBAL_TRACER
+
+
+def configure(*, enabled: Optional[bool] = None,
+              capacity: Optional[int] = None,
+              clock: Optional[Callable[[], float]] = None) -> Tracer:
+    """Reconfigure the process-global tracer IN PLACE (object identity is
+    preserved — call sites that hoisted ``get_tracer()`` stay wired).
+    A ``capacity`` change keeps the newest events that fit; a ``clock``
+    change clears the buffer (events from two clock domains on one
+    timeline would be garbage)."""
+    t = _GLOBAL_TRACER
+    if capacity is not None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        t._events = deque(t._events, maxlen=capacity)
+        t.capacity = capacity
+    if clock is not None:
+        t._clock = clock
+        t._events.clear()
+        t._epoch = clock()
+    if enabled is not None:
+        t.set_enabled(enabled)
+    return t

@@ -52,12 +52,31 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.compat import shard_map
 from ..core.mesh import STAGE_AXIS
 from ..nn.layer import Layer
+from ..obs import get_tracer
+
+
+def _with_dispatch_span(jitted, name: str, **attrs):
+    """Wrap a jitted schedule step in an obs dispatch span.
+
+    The whole schedule is ONE XLA program, so per-stage host spans don't
+    exist here (use xprof for intra-dispatch attribution); the span records
+    each step's host-side dispatch on the ``pipeline`` track — enough to
+    see step cadence and host stalls next to the feed/serve tracks. The
+    wrapper forwards ``lower`` (the HLO-inspection tests use it) and is a
+    plain passthrough when tracing is disabled."""
+    def step(*args):
+        with get_tracer().span(name, track="pipeline", **attrs):
+            return jitted(*args)
+
+    step.lower = jitted.lower
+    step.__wrapped__ = jitted
+    return step
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
@@ -209,7 +228,10 @@ def make_compiled_pipeline_train_step(
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
         return new_params, new_opt, loss, outs
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return _with_dispatch_span(
+        jax.jit(step, donate_argnums=(0, 1)), "pipe.compiled.step",
+        schedule="gpipe", stages=num_stages,
+        microbatches=num_microbatches)
 
 
 class HeteroCompiledPipeline:
@@ -457,7 +479,9 @@ class HeteroCompiledPipeline:
                                                    flat_params, lr)
             return new_params, new_opt, new_state, loss, logits
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return _with_dispatch_span(
+            jax.jit(step, donate_argnums=(0, 1, 2)), "pipe.compiled.step",
+            schedule="gpipe", stages=S, microbatches=M)
 
 
     # ---------------------------------------------------------------- 1F1B
@@ -688,7 +712,9 @@ class HeteroCompiledPipeline:
                                                    flat_params, lr)
             return new_params, new_opt, new_state, loss, logits
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return _with_dispatch_span(
+            jax.jit(step, donate_argnums=(0, 1, 2)), "pipe.compiled.step",
+            schedule="1f1b", stages=S, microbatches=M)
 
 
 def _prod(shape) -> int:

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..core.fence import hard_fence
 from ..nn.sequential import Sequential
+from ..obs import get_tracer
 from ..ops.losses import LOSSES
 from ..ops.metrics import correct_count
 from ..optim.optimizers import Optimizer, OptimizerFactory
@@ -210,17 +211,27 @@ class PipelineStage:
                 # SAMPLE_EVERY-1 queued microbatches and over-reports
                 hard_fence((self._last_out, x))
             t0 = time.perf_counter()
-            y, new_state = self._fwd(self.params, self.state, x, rng, training)
-            self._probe = (x, rng, training)
-            if training:
-                # residuals for backward; BN etc. must see the pre-update state
-                self._cache[mb_id] = (x, self.state, rng)
-                self.state = new_state
-            self._last_out = y
-            if sample:
-                hard_fence(y)  # D2H fence: block_until_ready lies on tunnelled TPU
-                self.load.forward_ms += (time.perf_counter() - t0) * 1e3
-                self.load.forward_count += 1
+            # span on this stage's own track ("stage<i>"): the Perfetto
+            # row layout that makes fill/steady/drain bubbles visible.
+            # Unsampled spans measure async dispatch issue; sampled ones
+            # (fenced below) are device-true — `fenced` says which.
+            with get_tracer().span("pipe.fwd", track=f"stage{self.stage_id}",
+                                   stage=self.stage_id, mb=mb_id,
+                                   fenced=bool(sample)):
+                y, new_state = self._fwd(self.params, self.state, x, rng,
+                                         training)
+                self._probe = (x, rng, training)
+                if training:
+                    # residuals for backward; BN etc. must see the
+                    # pre-update state
+                    self._cache[mb_id] = (x, self.state, rng)
+                    self.state = new_state
+                self._last_out = y
+                if sample:
+                    # D2H fence: block_until_ready lies on tunnelled TPU
+                    hard_fence(y)
+                    self.load.forward_ms += (time.perf_counter() - t0) * 1e3
+                    self.load.forward_count += 1
             return y
         except PipelineError:
             raise
@@ -243,13 +254,17 @@ class PipelineStage:
                 # fencing it drains the backlog (see forward())
                 hard_fence((self._grad_acc, grad))
             t0 = time.perf_counter()
-            self._grad_acc, xgrad = self._bwd(self.params, state, x, rng, grad, self._grad_acc)
-            self._grad_count += 1
-            self._last_out = xgrad
-            if sample:
-                hard_fence(xgrad)
-                self.load.backward_ms += (time.perf_counter() - t0) * 1e3
-                self.load.backward_count += 1
+            with get_tracer().span("pipe.bwd", track=f"stage{self.stage_id}",
+                                   stage=self.stage_id, mb=mb_id,
+                                   fenced=bool(sample)):
+                self._grad_acc, xgrad = self._bwd(self.params, state, x, rng,
+                                                  grad, self._grad_acc)
+                self._grad_count += 1
+                self._last_out = xgrad
+                if sample:
+                    hard_fence(xgrad)
+                    self.load.backward_ms += (time.perf_counter() - t0) * 1e3
+                    self.load.backward_count += 1
             return xgrad
         except PipelineError:
             raise
@@ -422,7 +437,10 @@ class InProcessPipelineCoordinator:
         update (reference sync_pipeline_coordinator.cpp:99-201)."""
         snap = [s.snapshot_state() for s in self.stages]
         try:
-            return self._train_batch_sync(x, y, lr, rng)
+            with get_tracer().span("pipe.batch", track="pipeline",
+                                   schedule="sync",
+                                   microbatches=self.num_microbatches):
+                return self._train_batch_sync(x, y, lr, rng)
         except Exception:
             self.abort_batch(snap)
             raise
@@ -464,7 +482,10 @@ class InProcessPipelineCoordinator:
         1F1B overlap the reference gets from its event loops."""
         snap = [s.snapshot_state() for s in self.stages]
         try:
-            return self._train_batch_semi_async(x, y, lr, rng)
+            with get_tracer().span("pipe.batch", track="pipeline",
+                                   schedule="semi_async",
+                                   microbatches=self.num_microbatches):
+                return self._train_batch_semi_async(x, y, lr, rng)
         except Exception:
             self.abort_batch(snap)
             raise

@@ -30,8 +30,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from ..core.compat import shard_map
 from ..core.mesh import SEQ_AXIS
 from ..core.precision import precision_keyed_jit
 from ..ops.attention import NEG_INF, _online_block

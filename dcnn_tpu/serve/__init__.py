@@ -18,7 +18,14 @@ subsystem puts it online:
   unboundedly;
 - :class:`~dcnn_tpu.serve.metrics.ServeMetrics` — rolling p50/p95/p99
   latency, queue depth, batch occupancy, throughput, shed fraction, as a
-  snapshot dict.
+  snapshot dict; backed by the shared ``dcnn_tpu.obs`` registry with
+  Prometheus text exposition (:meth:`ServeMetrics.prometheus`).
+
+The whole path is traced on the unified tracer (``dcnn_tpu.obs``):
+``serve.queue`` (enqueue → dispatch, cross-thread), ``serve.dispatch`` ⊃
+``serve.infer``, ``serve.compile``/``serve.warmup``, and ``serve.shed``
+instants — a request's latency decomposes into queue/batch/compute on a
+Perfetto timeline (docs/observability.md).
 
 End-to-end drivers: ``examples/serve_snapshot.py`` (committed digits28
 snapshot under open-loop traffic) and ``BENCH_SERVE=1 python bench.py``

@@ -37,6 +37,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs import get_tracer
 from .engine import InferenceEngine
 from .metrics import ServeMetrics
 
@@ -46,11 +47,14 @@ class QueueFullError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "n", "single", "future", "t_submit")
+    __slots__ = ("x", "n", "single", "future", "t_submit", "span")
 
-    def __init__(self, x, n, single, future, t_submit):
+    def __init__(self, x, n, single, future, t_submit, span=None):
         self.x, self.n, self.single = x, n, single
         self.future, self.t_submit = future, t_submit
+        # cross-thread obs span: begun on the submitter thread, ended on
+        # whichever thread dispatches (its length = queue+window residency)
+        self.span = span
 
 
 class DynamicBatcher:
@@ -114,15 +118,19 @@ class DynamicBatcher:
                              f"{self.max_batch}]; chunk it or use "
                              f"engine.infer")
         fut: Future = Future()
+        tracer = get_tracer()
         with self._cond:
             if self._closing:
                 raise RuntimeError("batcher is draining or shut down")
             if self._rows + n > self.queue_capacity:
                 self.metrics.record_shed(n)
+                tracer.instant("serve.shed", track="serve.queue", n=n)
                 raise QueueFullError(
                     f"queue at capacity ({self._rows}/{self.queue_capacity}"
                     f" samples); request of {n} shed")
-            self._q.append(_Request(x, n, single, fut, self._clock()))
+            self._q.append(_Request(
+                x, n, single, fut, self._clock(),
+                span=tracer.begin("serve.queue", track="serve.queue", n=n)))
             self._rows += n
             self.metrics.record_submit(n)
             self.metrics.record_queue_depth(self._rows)
@@ -147,6 +155,7 @@ class DynamicBatcher:
                    or self._clock() >= self._q[0].t_submit + self.max_wait_s)
             if not due:
                 return []
+            tracer = get_tracer()
             batch, rows = [], 0
             while self._q and rows + self._q[0].n <= self.max_batch:
                 req = self._q.popleft()
@@ -155,21 +164,30 @@ class DynamicBatcher:
                 # batch, and drops one the caller cancelled while queued
                 # (set_result on it would otherwise poison the scatter)
                 if not req.future.set_running_or_notify_cancel():
+                    tracer.end(req.span, cancelled=True)
                     continue
+                tracer.end(req.span)  # queue residency: enqueue -> dispatch
                 rows += req.n
                 batch.append(req)
             self.metrics.record_queue_depth(self._rows)
             return batch
 
     def _run(self, batch: List[_Request]) -> None:
+        tracer = get_tracer()
         try:
             x = (batch[0].x if len(batch) == 1
                  else np.concatenate([r.x for r in batch]))
             rows = x.shape[0]
-            padded, _ = self.engine.pad_to_bucket(x)
-            # np.asarray materializes on host — a hard fence, so recorded
-            # latency covers the full compute, and scatter is cheap views
-            y = np.asarray(self.engine.run_padded(padded))
+            with tracer.span("serve.dispatch", track="serve",
+                             requests=len(batch), rows=rows) as dspan:
+                padded, _ = self.engine.pad_to_bucket(x)
+                dspan.set(bucket=int(padded.shape[0]))
+                # np.asarray materializes on host — a hard fence, so
+                # recorded latency covers the full compute, and scatter is
+                # cheap views; the infer span is therefore device-true
+                with tracer.span("serve.infer", track="serve",
+                                 bucket=int(padded.shape[0]), rows=rows):
+                    y = np.asarray(self.engine.run_padded(padded))
             t_done = self._clock()
             off = 0
             for r in batch:
@@ -245,8 +263,10 @@ class DynamicBatcher:
             self._rows = 0
             self.metrics.record_queue_depth(0)
             self._cond.notify_all()
+        tracer = get_tracer()
         for r in pending:
             r.future.cancel()
+            tracer.end(r.span, cancelled=True)
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None

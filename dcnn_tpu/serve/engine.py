@@ -47,6 +47,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import get_tracer
+
 
 def serve_buckets(max_batch: int) -> List[int]:
     """Batch buckets: powers of two up to ``max_batch``, with ``max_batch``
@@ -89,16 +91,21 @@ class InferenceEngine:
         jitted = jax.jit(apply_fn, donate_argnums=(0,) if donate else ())
         self._sessions: Dict[int, Any] = {}
         self.compile_stats: Dict[int, Dict[str, float]] = {}
+        tracer = get_tracer()
         for b in self.bucket_sizes:
             spec = jax.ShapeDtypeStruct((b, *self.input_shape),
                                         self.input_dtype)
             t0 = time.perf_counter()
-            session = jitted.lower(spec).compile()
+            with tracer.span("serve.compile", track="serve",
+                             engine=name, bucket=b):
+                session = jitted.lower(spec).compile()
             compile_s = time.perf_counter() - t0
             t0 = time.perf_counter()
             if warmup:
-                jax.block_until_ready(session(jnp.zeros(
-                    (b, *self.input_shape), self.input_dtype)))
+                with tracer.span("serve.warmup", track="serve",
+                                 engine=name, bucket=b):
+                    jax.block_until_ready(session(jnp.zeros(
+                        (b, *self.input_shape), self.input_dtype)))
             self._sessions[b] = session
             self.compile_stats[b] = {
                 "compile_s": round(compile_s, 4),

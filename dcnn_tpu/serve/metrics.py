@@ -9,6 +9,26 @@ batcher and the bench pass nothing and get wall time. (Same motive as the
 reference's ``Matrix`` profiling maps being plain data — measurement that
 can be driven deterministically is measurement that can be tested.)
 
+Since the ``dcnn_tpu.obs`` subsystem landed, every recorder ALSO feeds a
+:class:`~dcnn_tpu.obs.registry.MetricsRegistry` (counters / queue-depth
+gauge / log-bucketed latency histogram) — by default a **private
+per-instance one**; pass ``registry=`` to pool instruments into a shared
+registry (e.g. ``obs.get_registry()``) when one scrape endpoint should
+cover the process. Constructing on a shared registry never resets the
+shared instruments (a second batcher must not zero the first's
+cumulative counters — Prometheus counters may never go backwards);
+:meth:`reset` does reset them, explicitly. :meth:`prometheus` exports
+the text exposition either way, with the exact windowed percentiles
+appended as gauges.
+
+The :meth:`snapshot` source of truth stays the pre-obs internal state —
+plain fields and the exact-percentile deques under ONE lock — so it
+remains a consistent point-in-time view (and nearest-rank percentiles
+stay exact under the fake clock, which a fixed-bucket histogram cannot
+provide). The registry instruments are the scrape-side mirror of the
+same stream, self-consistent for ``rate()`` but not atomically coupled
+to a given ``snapshot()``.
+
 All recorders are thread-safe (the batcher's dispatcher thread and many
 submitter threads hit them concurrently) and O(1); ``snapshot()`` does the
 O(window log window) percentile sort, once, on the caller's thread.
@@ -20,6 +40,8 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Optional
+
+from ..obs.registry import MetricsRegistry
 
 
 class ServeMetrics:
@@ -33,66 +55,103 @@ class ServeMetrics:
     """
 
     def __init__(self, *, window: int = 4096,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         self._clock = clock
         self._window = window
         self._lock = threading.Lock()
-        self.reset()
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(clock=clock))
+        self._submitted = self.registry.counter(
+            "serve_samples_submitted_total",
+            "samples accepted into the request queue")
+        self._completed = self.registry.counter(
+            "serve_samples_completed_total", "samples served")
+        self._shed = self.registry.counter(
+            "serve_samples_shed_total", "samples rejected by backpressure")
+        self._batches = self.registry.counter(
+            "serve_batches_total", "dispatched batches")
+        self._queue_depth = self.registry.gauge(
+            "serve_queue_depth", "samples currently queued")
+        self._lat_hist = self.registry.histogram(
+            "serve_latency_seconds", "request latency (submit to complete)")
+        # initialize the per-instance state WITHOUT touching the registry
+        # instruments: on an injected shared registry they may belong to a
+        # live sibling instance, and a counter must never go backwards
+        # because someone constructed a second batcher
+        self._init_local()
 
-    def reset(self) -> None:
-        """Zero every counter and restart the throughput wall-clock."""
+    def _init_local(self) -> None:
         with self._lock:
             self._lat_s: deque = deque(maxlen=self._window)
             self._occ: deque = deque(maxlen=self._window)
-            self._submitted = 0
-            self._completed = 0
-            self._shed = 0
-            self._batches = 0
-            self._queue_depth = 0
+            self._submitted_n = 0
+            self._completed_n = 0
+            self._shed_n = 0
+            self._batches_n = 0
+            self._depth_n = 0
             self._t0 = self._clock()
+
+    def reset(self) -> None:
+        """Zero every counter and restart the throughput wall-clock. Also
+        resets this instance's registry instruments — on an injected
+        shared registry that zeroes the shared series (an explicit caller
+        decision here, never an accident of construction)."""
+        self._init_local()
+        for inst in (self._submitted, self._completed, self._shed,
+                     self._batches, self._queue_depth, self._lat_hist):
+            inst.reset()
 
     # -- recorders (all O(1), thread-safe) --
     def record_submit(self, n: int = 1) -> None:
         """A request of ``n`` samples was accepted into the queue."""
         with self._lock:
-            self._submitted += n
+            self._submitted_n += n
+        self._submitted.inc(n)
 
     def record_shed(self, n: int = 1) -> None:
         """A request of ``n`` samples was rejected by backpressure."""
         with self._lock:
-            self._shed += n
+            self._shed_n += n
+        self._shed.inc(n)
 
     def record_queue_depth(self, depth: int) -> None:
         """Gauge: samples currently queued (set on enqueue and dispatch)."""
         with self._lock:
-            self._queue_depth = depth
+            self._depth_n = depth
+        self._queue_depth.set(depth)
 
     def record_batch(self, size: int, bucket: int) -> None:
         """A batch of ``size`` real samples ran in a ``bucket``-sized
         session; occupancy = size/bucket (the padding waste indicator)."""
         with self._lock:
-            self._batches += 1
+            self._batches_n += 1
             self._occ.append(size / max(bucket, 1))
+        self._batches.inc()
 
     def record_done(self, latency_s: float, n: int = 1) -> None:
         """A request of ``n`` samples completed ``latency_s`` after it was
         submitted (queue wait + batching delay + compute)."""
         with self._lock:
-            self._completed += n
+            self._completed_n += n
             self._lat_s.append(latency_s)
+        self._completed.inc(n)
+        self._lat_hist.observe(latency_s)
 
     # -- export --
     def snapshot(self) -> Dict[str, Optional[float]]:
-        """Point-in-time view. Latency keys are ``None`` until the first
-        completion so a consumer can't mistake 'no data' for 'zero ms'."""
+        """Point-in-time view (every field read under ONE lock — e.g.
+        ``requests_completed`` always agrees with the latency window).
+        Latency keys are ``None`` until the first completion so a consumer
+        can't mistake 'no data' for 'zero ms'."""
         with self._lock:
             lat = sorted(self._lat_s)
             occ = list(self._occ)
-            submitted, completed = self._submitted, self._completed
-            shed, batches = self._shed, self._batches
-            depth = self._queue_depth
+            submitted, completed = self._submitted_n, self._completed_n
+            shed, batches = self._shed_n, self._batches_n
+            depth = self._depth_n
             wall_s = max(self._clock() - self._t0, 0.0)
 
         def pct(q: float) -> Optional[float]:
@@ -119,6 +178,29 @@ class ServeMetrics:
             "throughput_rps": (completed / wall_s) if wall_s > 0 else None,
             "wall_s": wall_s,
         }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition: the registry instruments (counters,
+        queue-depth gauge, latency histogram) plus the exact windowed
+        percentiles/occupancy appended as gauges (they are derived views
+        over the rolling window, not registry instruments)."""
+        s = self.snapshot()
+        lines = [self.registry.prometheus().rstrip("\n")]
+        derived = {
+            "serve_latency_window_p50_ms": s["p50_ms"],
+            "serve_latency_window_p95_ms": s["p95_ms"],
+            "serve_latency_window_p99_ms": s["p99_ms"],
+            "serve_latency_window_mean_ms": s["mean_ms"],
+            "serve_batch_occupancy": s["batch_occupancy"],
+            "serve_shed_fraction": s["shed_fraction"],
+            "serve_throughput_rps": s["throughput_rps"],
+        }
+        for name, v in derived.items():
+            if v is None:
+                continue  # absent series, not a lying 0.0
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {v!r}")
+        return "\n".join(lines) + "\n"
 
     def __repr__(self) -> str:
         s = self.snapshot()

@@ -19,6 +19,9 @@ garbage timings. For production tracing, ``trace()`` wraps
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Optional
@@ -146,12 +149,49 @@ class LayerProfiler:
         return "\n".join(lines)
 
 
+_trace_lock = threading.Lock()
+_trace_active = False
+_trace_seq = itertools.count()
+
+
 @contextlib.contextmanager
 def trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
     """XLA-level trace for xprof/tensorboard (the TPU-native answer to the
-    reference's profiling commands, SURVEY.md §5.1)."""
-    jax.profiler.start_trace(log_dir)
+    reference's profiling commands, SURVEY.md §5.1).
+
+    ``log_dir`` is the PARENT: every call captures into its own
+    timestamped subdir (``<log_dir>/<YYYYmmdd-HHMMSS>-<pid>-<seq>``,
+    yielded to the caller), so back-to-back traces never clobber each
+    other's capture — the old single hard-coded dir made the second
+    trace of a process overwrite the first. Nested use raises a clear
+    ``RuntimeError`` up front: ``jax.profiler`` supports one capture per
+    process, and the error it raises mid-capture is cryptic.
+
+    The capture is also recorded as a ``profiler.xprof`` span on the
+    shared tracer (``dcnn_tpu.obs``), so an xprof capture shows up on the
+    span timeline and both can run together.
+    """
+    global _trace_active
+    with _trace_lock:
+        if _trace_active:
+            raise RuntimeError(
+                "profiling.trace() does not nest: an xprof capture is "
+                "already active in this process (jax.profiler supports one "
+                "trace at a time); finish it before starting another")
+        _trace_active = True
     try:
-        yield log_dir
+        path = os.path.join(
+            log_dir, f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+                     f"-{next(_trace_seq):03d}")
+        os.makedirs(path, exist_ok=True)
+        from ..obs import get_tracer
+        with get_tracer().span("profiler.xprof", track="profiler",
+                               log_dir=path):
+            jax.profiler.start_trace(path)
+            try:
+                yield path
+            finally:
+                jax.profiler.stop_trace()
     finally:
-        jax.profiler.stop_trace()
+        with _trace_lock:
+            _trace_active = False

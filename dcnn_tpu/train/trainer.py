@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..core.config import ProfilerType, TrainingConfig
 from ..nn.sequential import Sequential
+from ..obs import get_registry, get_tracer
 from ..ops.losses import get_loss, upcast_logits
 from ..ops.metrics import correct_count
 from ..optim.optimizers import Optimizer
@@ -254,6 +255,25 @@ class Trainer:
         self.lr = self.config.learning_rate
         self.history: list = []
 
+    @staticmethod
+    def _epoch_samples(loader) -> Optional[int]:
+        """Best-effort samples-per-epoch for the throughput gauge. None
+        (gauge skipped) when the loader exposes no length — telemetry never
+        guesses."""
+        # steps*batch first: it is what an epoch actually consumes — a
+        # drop-last loader's num_samples would overcount the tail
+        spe = getattr(loader, "steps_per_epoch", None)
+        bs = getattr(loader, "batch_size", None)
+        if spe and bs:
+            return int(spe) * int(bs)
+        n = getattr(loader, "num_samples", None)
+        if n:
+            return int(n)
+        x = getattr(loader, "x", None)
+        if x is not None and hasattr(x, "shape"):
+            return int(x.shape[0])
+        return None
+
     def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
                     epoch: int = 0) -> Tuple[TrainState, float, float]:
         from ..data.device_dataset import DeviceDataset, ShardedDeviceDataset
@@ -263,14 +283,19 @@ class Trainer:
             return self._train_epoch_resident(ts, loader, rng, epoch)
         if self.multi_step is not None:
             return self._train_epoch_chunked(ts, loader, rng, epoch)
+        tracer = get_tracer()
         total_loss, total_correct, total_n, batches = 0.0, 0, 0, 0
         t0 = time.perf_counter()
         for bi, (x, y) in enumerate(loader):
             x, y = jnp.asarray(x), jnp.asarray(y)
             step_rng = jax.random.fold_in(rng, bi)
-            ts, loss, logits = self.train_step(ts, x, y, step_rng, self.lr)
-            total_loss += float(loss) * x.shape[0]
-            total_correct += int(correct_count(logits, y))
+            # the float(loss)/correct_count reads inside the span block on
+            # the device result, so step spans tile the epoch wall truthfully
+            with tracer.span("train.step", track="train", epoch=epoch,
+                             batch=bi):
+                ts, loss, logits = self.train_step(ts, x, y, step_rng, self.lr)
+                total_loss += float(loss) * x.shape[0]
+                total_correct += int(correct_count(logits, y))
             total_n += x.shape[0]
             batches += 1
             if (self.scheduler is not None
@@ -310,9 +335,13 @@ class Trainer:
                 raise NotImplementedError(
                     "per-batch LR scheduling with ShardedDeviceDataset: the "
                     "DP epoch takes a scalar lr; use scheduler_step='epoch'")
-            ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
-                                     jax.random.fold_in(rng, epoch), self.lr)
-            return ts, float(mean_loss), float("nan")
+            with get_tracer().span("train.resident_epoch", track="train",
+                                   epoch=epoch, dp=True):
+                ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
+                                         jax.random.fold_in(rng, epoch),
+                                         self.lr)
+                mean_loss = float(mean_loss)
+            return ts, mean_loss, float("nan")
         from ..data.device_dataset import resident_epoch
         epoch_fn = resident_epoch(self.model, self.loss_fn, self.optimizer, ds,
                                   self.config.num_microbatches)
@@ -328,9 +357,14 @@ class Trainer:
             lr_arg = jnp.asarray(lrs, jnp.float32)
         else:
             lr_arg = self.lr
-        ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
-                                 jax.random.fold_in(rng, epoch), lr_arg)
-        return ts, float(mean_loss), float("nan")
+        # one dispatch runs the whole epoch; float() fences, so the span is
+        # the true epoch device wall
+        with get_tracer().span("train.resident_epoch", track="train",
+                               epoch=epoch):
+            ts, mean_loss = epoch_fn(ts, ds.x, ds.y,
+                                     jax.random.fold_in(rng, epoch), lr_arg)
+            mean_loss = float(mean_loss)
+        return ts, mean_loss, float("nan")
 
     def _train_epoch_chunked(self, ts: TrainState, loader, rng: jax.Array,
                              epoch: int = 0) -> Tuple[TrainState, float, float]:
@@ -371,9 +405,12 @@ class Trainer:
                 lr_arg = jnp.asarray(lrs, jnp.float32)
             else:
                 lr_arg = self.lr
-            ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, lr_arg)
-            n = xs.shape[0] * xs.shape[1]
-            total_loss += float(mean_loss) * n
+            with get_tracer().span("train.chunk", track="train",
+                                   epoch=epoch, chunk=ci,
+                                   steps=int(xs.shape[0])):
+                ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, lr_arg)
+                n = xs.shape[0] * xs.shape[1]
+                total_loss += float(mean_loss) * n
             total_n += n
             if self.config.progress_interval and (ci + 1) % max(
                     self.config.progress_interval // max(xs.shape[0], 1), 1) == 0:
@@ -389,13 +426,32 @@ class Trainer:
         epochs = epochs or cfg.epochs
         rng = jax.random.PRNGKey(seed if seed is not None else cfg.seed)
         best_val = -1.0
+        tracer = get_tracer()
+        reg = get_registry()
         for epoch in range(1, epochs + 1):
             if hasattr(train_loader, "shuffle"):
                 train_loader.shuffle(epoch)
             epoch_rng = jax.random.fold_in(rng, epoch)
             t0 = time.perf_counter()
-            ts, train_loss, train_acc = self.train_epoch(ts, train_loader, epoch_rng, epoch)
+            with tracer.span("train.epoch", track="train", epoch=epoch):
+                ts, train_loss, train_acc = self.train_epoch(
+                    ts, train_loader, epoch_rng, epoch)
             dt = time.perf_counter() - t0
+            # per-epoch telemetry rollups on the shared registry — O(1),
+            # once per epoch, live whether or not tracing is enabled
+            n_epoch = self._epoch_samples(train_loader)
+            reg.counter("train_epochs_total", "completed epochs").inc()
+            if n_epoch:
+                reg.counter("train_samples_total",
+                            "samples trained on").inc(n_epoch)
+                reg.gauge("train_throughput_ips",
+                          "last epoch samples/sec").set(n_epoch / dt)
+            reg.histogram("train_epoch_seconds",
+                          "wall per epoch").observe(dt)
+            reg.gauge("train_lr", "current learning rate").set(
+                float(self.lr))
+            reg.gauge("train_loss", "last epoch mean train loss").set(
+                float(train_loss))
 
             if self.profiler is not None:
                 # One profiled layer-by-layer fwd/bwd per epoch (device-synced
@@ -440,9 +496,12 @@ class Trainer:
 
             val_loss = val_acc = None
             if val_loader is not None:
-                val_loss, val_acc = evaluate_classification(
-                    self.model, ts.params, ts.state, self.loss_fn, val_loader,
-                    eval_step=self.eval_step)
+                with tracer.span("train.eval", track="train", epoch=epoch):
+                    val_loss, val_acc = evaluate_classification(
+                        self.model, ts.params, ts.state, self.loss_fn,
+                        val_loader, eval_step=self.eval_step)
+                reg.gauge("train_val_acc", "last validation accuracy").set(
+                    float(val_acc))
                 # best-val snapshot (reference train.hpp:254-264)
                 if cfg.snapshot_dir and val_acc > best_val:
                     best_val = val_acc

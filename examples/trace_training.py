@@ -1,0 +1,122 @@
+"""Observability smoke driver: train 2 epochs on digits28 with the unified
+tracer enabled, export a Chrome ``trace_event`` artifact, and verify it
+parses.
+
+The smallest end-to-end demonstration of ``dcnn_tpu.obs``
+(docs/observability.md): enable the process-global tracer, run a real
+(tiny) training job through the standard ``Trainer``, and write the
+span timeline — ``train.epoch`` / ``train.step`` / ``train.eval`` on the
+"train" track — as a single JSON file Perfetto (https://ui.perfetto.dev)
+or ``chrome://tracing`` loads directly, plus the metrics-registry
+snapshot the same run accumulated. The script asserts the artifact is
+valid Chrome-trace JSON before declaring success, so it doubles as the
+CI smoke for the export path (``tests/test_obs.py`` imports it; running
+it end-to-end is this file's ``main()``).
+
+Usage:
+    python examples/trace_training.py [out.json]
+
+Env knobs: ``TRACE_EPOCHS`` (default 2), ``TRACE_OUT`` (default
+``/tmp/dcnn_trace_training.json``; argv wins).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from common import setup
+
+import dcnn_tpu  # noqa: F401  (platform override side effects)
+
+from dcnn_tpu.obs import configure, get_registry, get_tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def train_traced(epochs: int = 2):
+    """Train ``epochs`` on digits28 (synthetic fallback) with tracing on;
+    returns the Trainer. Separated from main() so tests can call it."""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data import MNISTDataLoader
+    from dcnn_tpu.models import create_mnist_trainer
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.train.trainer import Trainer, create_train_state
+
+    import jax
+
+    from common import loader_or_synthetic
+
+    cfg = TrainingConfig(epochs=epochs, batch_size=64, progress_interval=0)
+
+    def real():
+        from dcnn_tpu.data.digits28 import ensure_digits28_csvs
+
+        d = ensure_digits28_csvs(ROOT)
+        train = MNISTDataLoader(os.path.join(d, "train.csv"),
+                                data_format="NCHW", batch_size=64, seed=0)
+        val = MNISTDataLoader(os.path.join(d, "test.csv"),
+                              data_format="NCHW", batch_size=256,
+                              shuffle=False, drop_last=False)
+        train.load_data()
+        val.load_data()
+        return train, val
+
+    train, val = loader_or_synthetic(real, (1, 28, 28), 10, cfg,
+                                     n_train=512, n_val=128)
+    model = create_mnist_trainer()
+    trainer = Trainer(model, Adam(1e-3), "softmax_crossentropy", cfg)
+    ts = create_train_state(model, trainer.optimizer, jax.random.PRNGKey(0))
+    trainer.fit(ts, train, val, epochs=epochs)
+    return trainer
+
+
+def validate_chrome_trace(path: str) -> dict:
+    """json.load the artifact and check the trace_event invariants the
+    viewers rely on. Returns {span name: count}. Raises on violation."""
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs, "empty traceEvents"
+    counts: dict = {}
+    for ev in evs:
+        assert {"ph", "pid", "tid", "name"} <= set(ev), f"bad event {ev}"
+        if ev["ph"] == "X":
+            assert "ts" in ev and "dur" in ev and ev["dur"] >= 0
+            counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    named_tracks = {ev["args"]["name"] for ev in evs
+                    if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    assert "train" in named_tracks, f"no labeled train track: {named_tracks}"
+    return counts
+
+
+def main():
+    setup("trace_training")
+    out_path = (sys.argv[1] if len(sys.argv) > 1
+                else os.environ.get("TRACE_OUT",
+                                    "/tmp/dcnn_trace_training.json"))
+    epochs = int(os.environ.get("TRACE_EPOCHS", "2"))
+
+    configure(enabled=True)
+    try:
+        trainer = train_traced(epochs)
+    finally:
+        configure(enabled=False)
+
+    tracer = get_tracer()
+    tracer.export_chrome(out_path)
+    counts = validate_chrome_trace(out_path)
+    assert counts.get("train.epoch", 0) == epochs, counts
+    assert counts.get("train.step", 0) >= epochs, counts
+
+    print(f"trace: {out_path} ({len(tracer)} events) — "
+          f"open at https://ui.perfetto.dev")
+    print(f"spans: {counts}")
+    print("metrics snapshot:")
+    print(json.dumps(get_registry().snapshot(), indent=2, default=str))
+    print(f"final val acc: {trainer.history[-1]['val_acc']}")
+
+
+if __name__ == "__main__":
+    main()
