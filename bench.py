@@ -51,7 +51,10 @@ under a "serving" key), BENCH_OBS=1 to enable the unified tracer
 training step spans on the "train" track, per-chunk H2D gather/put spans
 on the transfer-thread tracks, serve spans under BENCH_SERVE=1) and
 appends a "telemetry" block (trace path, span counts, metrics-registry
-snapshot) to the JSON line. See docs/observability.md.
+snapshot) to the JSON line (see docs/observability.md), BENCH_FAULTS=1 for
+the checkpoint save/restore overhead probe (dcnn_tpu/resilience/; knob
+BENCH_FAULTS_REPS — emitted under a "resilience" key: sync save wall,
+async save's step-loop cost, verified-restore wall; docs/reliability.md).
 """
 
 from __future__ import annotations
@@ -565,6 +568,65 @@ def serve_section(data_format, engine=None, loads=None, seconds=None):
     }
 
 
+def faults_section():
+    """BENCH_FAULTS=1: the measured cost of robustness — checkpoint
+    save/restore wall for a real model's train state, sync vs async (the
+    async number is what the step loop actually pays: the device_get
+    snapshot + enqueue), plus verified-restore time. Small fixed model
+    (the serving-scale digits CNN shape) so the number is comparable
+    across runs; knob BENCH_FAULTS_REPS (default 5)."""
+    import tempfile
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.resilience import CheckpointManager
+    from dcnn_tpu.train.trainer import create_train_state
+
+    reps = int(os.environ.get("BENCH_FAULTS_REPS", "5"))
+    model = (SequentialBuilder("bench_ckpt")
+             .input((1, 28, 28))
+             .conv2d(32, 3, 1, 1).batchnorm().activation("relu")
+             .conv2d(32, 3, 1, 1).batchnorm().activation("relu")
+             .maxpool2d(2).flatten().dense(128).dense(10)
+             .build())
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    n_bytes = sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(
+        {"p": ts.params, "s": ts.state, "o": ts.opt_state}))
+
+    with tempfile.TemporaryDirectory() as d:
+        cm = CheckpointManager(d, keep=2)
+        sync_s, enqueue_s, restore_s = [], [], []
+        for i in range(reps):
+            t0 = _t.perf_counter()
+            cm.save(2 * i + 1, model, ts.params, ts.state, ts.opt_state,
+                    opt, {"rep": i})
+            sync_s.append(_t.perf_counter() - t0)
+            t0 = _t.perf_counter()
+            cm.save_async(2 * i + 2, model, ts.params, ts.state,
+                          ts.opt_state, opt, {"rep": i})
+            enqueue_s.append(_t.perf_counter() - t0)  # the step loop's cost
+            cm.wait()
+            t0 = _t.perf_counter()
+            r = cm.restore_latest()
+            restore_s.append(_t.perf_counter() - t0)
+            assert r is not None
+        cm.close()
+    return {
+        "state_bytes": int(n_bytes),
+        "reps": reps,
+        "save_sync_s": round(min(sync_s), 4),
+        "save_async_step_loop_s": round(min(enqueue_s), 4),
+        "async_blocking_fraction": round(min(enqueue_s) / max(min(sync_s),
+                                                              1e-9), 4),
+        "restore_verified_s": round(min(restore_s), 4),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -687,6 +749,11 @@ def main() -> None:
     # BENCH_SERVE_SECONDS of wall per run)
     if os.environ.get("BENCH_SERVE", "0") == "1":
         out["serving"] = serve_section(data_format)
+
+    # robustness has a measured cost: checkpoint save/restore overhead
+    # (opt-in; cheap — a few MB of state written a few times)
+    if os.environ.get("BENCH_FAULTS", "0") == "1":
+        out["resilience"] = faults_section()
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision

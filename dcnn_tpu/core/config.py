@@ -47,6 +47,27 @@ class TrainingConfig:
                                       # device dispatch (train.make_multi_step) —
                                       # the remote/tunnelled-TPU fast path
 
+    # -- fault tolerance (dcnn_tpu/resilience; docs/reliability.md) --
+    checkpoint_dir: Optional[str] = None  # root for periodic atomic checkpoints
+                                      # (CheckpointManager; separate from the
+                                      # best-val snapshot_dir)
+    checkpoint_every: int = 0         # epochs between periodic checkpoints
+                                      # (0 = off; needs checkpoint_dir)
+    checkpoint_keep: int = 3          # keep-last-K retention
+    checkpoint_async: bool = True     # background saver thread: the step loop
+                                      # pays only the device_get snapshot
+    resume: str = "never"             # "auto": restore the newest valid
+                                      # checkpoint from checkpoint_dir at
+                                      # fit() and continue | "never"
+    nonfinite_policy: str = "off"     # "off" (exact pre-guard graph) | "raise"
+                                      # | "skip_step" | "rollback" — see
+                                      # resilience.StepGuard
+    rollback_after: int = 3           # consecutive bad steps before a
+                                      # "rollback" policy restores the last
+                                      # checkpoint
+    stall_timeout_s: float = 0.0      # >0: StallWatchdog flags a hung
+                                      # step/data fetch on the obs registry
+
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
         """Environment-variable mapping mirroring ``train.hpp:80-100``."""
@@ -68,6 +89,14 @@ class TrainingConfig:
             scheduler_step=get_env("SCHEDULER_STEP", base.scheduler_step),
             steps_per_dispatch=get_env("STEPS_PER_DISPATCH",
                                        base.steps_per_dispatch),
+            checkpoint_dir=get_env("CKPT_DIR", base.checkpoint_dir or "") or None,
+            checkpoint_every=get_env("CKPT_EVERY", base.checkpoint_every),
+            checkpoint_keep=get_env("CKPT_KEEP", base.checkpoint_keep),
+            checkpoint_async=get_env("CKPT_ASYNC", base.checkpoint_async),
+            resume=get_env("CKPT_RESUME", base.resume),
+            nonfinite_policy=get_env("NONFINITE_POLICY", base.nonfinite_policy),
+            rollback_after=get_env("ROLLBACK_AFTER", base.rollback_after),
+            stall_timeout_s=get_env("STALL_TIMEOUT_S", base.stall_timeout_s),
         )
 
     def to_dict(self) -> dict:

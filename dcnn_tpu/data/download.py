@@ -50,10 +50,31 @@ UJI_URL = "https://archive.ics.uci.edu/static/public/310/ujiindoorloc.zip"
 
 
 def _fetch(url: str, md5: str | None = None) -> bytes:
+    from dcnn_tpu.resilience.retry import retry_call
+
     print(f"fetching {url} ...", flush=True)
-    try:
+
+    def attempt() -> bytes:
         with urllib.request.urlopen(url, timeout=120) as r:
-            data = r.read()
+            return r.read()
+
+    def transient(e: BaseException) -> bool:
+        # HTTPError carries .code; a permanent 4xx (404 dead mirror, 403)
+        # will not heal on retry — fail it immediately. 408/429 are the
+        # retryable 4xx; 5xx and everything code-less (resets, DNS) retry.
+        code = getattr(e, "code", None)
+        return not (isinstance(code, int) and 400 <= code < 500
+                    and code not in (408, 429))
+
+    try:
+        # transient mirror hiccups (resets, 5xx, DNS blips) ride the shared
+        # bounded backoff; a truly dead network still fails fast enough to
+        # re-run elsewhere. urllib errors all derive from OSError.
+        data = retry_call(
+            attempt, attempts=4, base=1.0, cap=15.0, retry_on=(OSError,),
+            retry_if=transient, name="dataset_download",
+            on_retry=lambda i, e, d: print(
+                f"  retry {i + 1} for {url} in {d:.1f}s ({e})", flush=True))
     except Exception as e:  # noqa: BLE001 - report url + cause and bail
         raise SystemExit(
             f"download failed for {url}: {e}\n"

@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import native
 from ..obs import get_tracer
+from ..resilience import faults as _faults
 from .transfer import TransferEngine
 
 
@@ -227,6 +228,10 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                 nxt = next(it, None)
                 if nxt is None:
                     break
+                # fault-injection point: an armed "stream.produce" raises
+                # here at shard at=i, proving the sentinel path delivers
+                # producer-thread failures to the training loop
+                _faults.trip("stream.produce", shard=i)
                 # per-chunk fencing happens on the engine's pool threads
                 # (device_put is async-ISSUE on the tunnelled backend —
                 # without the fence the queue would pace on issue time and

@@ -38,6 +38,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience.retry import retry_call
 from ..utils.compression import MetaCompressor, RawCompressor
 
 MAGIC = 0x44544E31
@@ -80,6 +82,10 @@ class Channel:
              raw: Optional[bytes] = None) -> None:
         m = dict(meta or {})
         m["cmd"] = cmd
+        # fault-injection point: an armed "comm.send" drops this frame on
+        # the floor (OSError), exercising the coordinator's abort/retry
+        # paths without a real network fault
+        _faults.trip("comm.send", cmd=cmd)
         payload = b""
         if array is not None:
             payload = _CODEC.compress_array(
@@ -155,26 +161,38 @@ def listen(port: int, host: str = "0.0.0.0") -> socket.socket:
 
 
 def connect(host: str, port: int, *, timeout: float = 60.0,
-            delay: float = 0.2, compress: bool = False) -> Channel:
-    """Connect, retrying until ``timeout`` seconds elapse — workers may come
-    up in any order and can take tens of seconds to import jax on a slow
-    host (the reference retries similarly via asio async_connect +
-    deploy_stages timeouts)."""
-    last: Optional[Exception] = None
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            s = socket.create_connection((host, port), timeout=30)
-            # the connect timeout must not linger: a 30s recv stall (jit
-            # compile, idle epoch gap) would look like a peer close to the
-            # reader thread
-            s.settimeout(None)
-            return Channel(s, compress=compress)
-        except OSError as e:
-            last = e
-            time.sleep(delay)
-    raise ConnectionError(f"cannot connect to {host}:{port} "
-                          f"within {timeout}s: {last}")
+            delay: float = 0.2, compress: bool = False,
+            sleep=time.sleep, clock=time.monotonic) -> Channel:
+    """Connect through the shared bounded-backoff primitive
+    (``resilience/retry.py``) — workers may come up in any order and can
+    take tens of seconds to import jax on a slow host (the reference
+    retries similarly via asio async_connect + deploy_stages timeouts).
+
+    Backoff starts at ``delay`` and doubles (jittered) to a 2 s cap until
+    ``timeout`` elapses; every retry lands on the obs registry
+    (``pipeline_connect_retry_attempts_total``), so a worker flapping its
+    way up is visible, not silent. ``sleep``/``clock`` are injectable for
+    sleep-free tests."""
+
+    def attempt() -> Channel:
+        _faults.trip("comm.connect", host=host, port=port)
+        s = socket.create_connection((host, port), timeout=30)
+        # the connect timeout must not linger: a 30s recv stall (jit
+        # compile, idle epoch gap) would look like a peer close to the
+        # reader thread
+        s.settimeout(None)
+        return Channel(s, compress=compress)
+
+    # attempts sized generously past the deadline: the timeout= budget is
+    # the real bound, matching the old fixed-delay loop's contract
+    attempts = max(2, int(timeout / max(delay, 1e-3)) + 1)
+    try:
+        return retry_call(attempt, attempts=attempts, base=delay, cap=2.0,
+                          timeout=timeout, retry_on=(OSError,),
+                          sleep=sleep, clock=clock, name="pipeline_connect")
+    except OSError as e:
+        raise ConnectionError(f"cannot connect to {host}:{port} "
+                              f"within {timeout}s: {e}") from e
 
 
 def parse_addr(addr: str) -> Tuple[str, int]:

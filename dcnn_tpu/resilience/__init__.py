@@ -1,0 +1,69 @@
+"""Fault tolerance: atomic/async checkpointing, step guards, retry, faults.
+
+The ROADMAP's north star is production-scale training and serving on
+*preemptible* fleets — machines that vanish mid-write, lose packets, and
+occasionally hand back an Inf. This package makes failure a first-class,
+*tested* event across the stack:
+
+- :mod:`~dcnn_tpu.resilience.checkpoint` — :class:`CheckpointManager`:
+  atomic commits (staged dir + manifest with per-file SHA-256 +
+  ``os.replace``), background async saves that never block the step loop
+  on disk, keep-last-K retention, and :func:`restore_latest` that skips
+  torn/corrupt checkpoints to the newest valid one.
+- :mod:`~dcnn_tpu.resilience.guards` — :class:`StepGuard` policies over
+  the jit-level non-finite detector in ``train.make_train_step(guard=
+  True)`` (``raise`` / ``skip_step`` / ``rollback``), plus
+  :class:`StallWatchdog` for hung steps/fetches.
+- :mod:`~dcnn_tpu.resilience.retry` — the one bounded-exponential-backoff
+  primitive (``retry_call`` / ``@retriable``), reused by pipeline worker
+  connects, dataset downloads, and checkpoint I/O; retries are counted on
+  the obs registry.
+- :mod:`~dcnn_tpu.resilience.faults` — deterministic seeded fault
+  injection (:class:`FaultPlan`): crash-before/after-rename, bit flips,
+  producer raises, forced non-finite steps, dropped sends. Every recovery
+  claim above is proven under it in ``tests/test_resilience.py``.
+
+Trainer integration: ``TrainingConfig(checkpoint_dir=..., checkpoint_every
+=N, resume="auto", nonfinite_policy="skip_step", stall_timeout_s=120)``.
+Recovery semantics and the fault-injection cookbook: docs/reliability.md.
+
+Submodule imports are lazy: ``train/checkpoint.py`` uses
+:mod:`~dcnn_tpu.resilience.atomic` while :mod:`~dcnn_tpu.resilience.checkpoint`
+imports ``train/checkpoint.py`` — laziness keeps that cycle-free, and
+``import dcnn_tpu.resilience`` stays jax-free.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "CheckpointManager": ("checkpoint", "CheckpointManager"),
+    "RestoredCheckpoint": ("checkpoint", "RestoredCheckpoint"),
+    "restore_latest": ("checkpoint", "restore_latest"),
+    "list_steps": ("checkpoint", "list_steps"),
+    "StepGuard": ("guards", "StepGuard"),
+    "StallWatchdog": ("guards", "StallWatchdog"),
+    "NonFiniteError": ("guards", "NonFiniteError"),
+    "retry_call": ("retry", "retry_call"),
+    "retriable": ("retry", "retriable"),
+    "backoff_delays": ("retry", "backoff_delays"),
+    "FaultPlan": ("faults", "FaultPlan"),
+    "InjectedFault": ("faults", "InjectedFault"),
+    "InjectedCrash": ("faults", "InjectedCrash"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod_name}", __name__), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,183 @@
+"""Step guards: non-finite loss/grad defense and a stall watchdog.
+
+One NaN step can poison an entire run — Adam's second-moment EMA never
+recovers from an Inf, and every later checkpoint inherits the damage. The
+defense is split the way jit demands:
+
+- **In-graph detection + neutralization** lives in
+  ``train.make_train_step(guard=True)``: the step computes
+  ``bad = ~isfinite(loss) | ~isfinite(grad_global_norm²)`` and selects
+  (``jnp.where``) between the updated and the *incoming*
+  params/state/opt_state/step — a skipped step is bit-identical to not
+  having run it, with no host round-trip inside the graph.
+- **Host-side policy** lives here, in :class:`StepGuard`: the Trainer
+  feeds it each step's ``bad`` flag (read with the loss it already pulls
+  to host) and the guard decides what the flag *means*:
+
+  - ``"raise"`` — abort with :class:`NonFiniteError` naming the step;
+  - ``"skip_step"`` — count it (``train_skipped_steps`` on the obs
+    registry) and keep going: params/opt_state were never touched;
+  - ``"rollback"`` — like skip, until ``rollback_after`` *consecutive*
+    bad steps, then tell the Trainer to restore the last checkpoint
+    (return value ``"rollback"``) — the Check-N-Run answer to a run whose
+    state is already subtly poisoned rather than one transient bad batch.
+
+The :class:`StallWatchdog` covers the other failure shape: a step or data
+fetch that never returns (hung remote TPU tunnel, wedged producer thread).
+Progress sites call :meth:`~StallWatchdog.beat`; a poll (background thread
+in production, direct :meth:`~StallWatchdog.check` with a fake clock in
+tests) flags ``train_stalled`` / ``train_stall_flags_total`` on the obs
+registry once no beat arrives within ``timeout_s`` — detection only, by
+design: killing a hung dispatch is the scheduler's job, surfacing it is
+ours.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Callable, Optional
+
+from ..obs import get_registry
+
+
+class NonFiniteError(FloatingPointError):
+    """Training produced a non-finite loss or gradient norm."""
+
+    def __init__(self, step: int, loss: float):
+        self.step = step
+        self.loss = loss
+        super().__init__(
+            f"non-finite loss/gradient at train step {step} (loss={loss!r}); "
+            f"policy 'raise' aborts — use nonfinite_policy='skip_step' or "
+            f"'rollback' to continue past transient bad batches")
+
+
+def global_norm_sq(tree):
+    """Σ‖leaf‖² over a pytree — the jit-friendly non-finiteness probe (the
+    square root is irrelevant for an isfinite check and costs a kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros(())
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+class StepGuard:
+    """Host-side policy for the in-graph ``bad`` flag. Returns one of
+    ``"ok" | "skipped" | "rollback"`` per step; raises for policy
+    ``"raise"``."""
+
+    POLICIES = ("raise", "skip_step", "rollback")
+
+    def __init__(self, policy: str = "raise", *, rollback_after: int = 3,
+                 registry=None):
+        if policy not in self.POLICIES:
+            raise ValueError(f"nonfinite_policy must be one of "
+                             f"{self.POLICIES}, got {policy!r}")
+        if rollback_after < 1:
+            raise ValueError(f"rollback_after must be >= 1, "
+                             f"got {rollback_after}")
+        self.policy = policy
+        self.rollback_after = rollback_after
+        self._reg = registry if registry is not None else get_registry()
+        self.consecutive_bad = 0
+        self.total_skipped = 0
+
+    def observe(self, step: int, bad: bool, loss: float = float("nan")) -> str:
+        if not bad:
+            self.consecutive_bad = 0
+            return "ok"
+        if self.policy == "raise":
+            raise NonFiniteError(step, loss)
+        self.consecutive_bad += 1
+        self.total_skipped += 1
+        self._reg.counter("train_skipped_steps",
+                          "train steps skipped by the non-finite guard").inc()
+        warnings.warn(
+            f"non-finite loss/grad at step {step}: step skipped "
+            f"({self.consecutive_bad} consecutive)", stacklevel=2)
+        if (self.policy == "rollback"
+                and self.consecutive_bad >= self.rollback_after):
+            self.consecutive_bad = 0
+            self._reg.counter(
+                "train_rollbacks_total",
+                "rollbacks to last checkpoint by the guard").inc()
+            return "rollback"
+        return "skipped"
+
+
+class StallWatchdog:
+    """Flags (never kills) a training loop that stopped making progress.
+
+    ``beat()`` on every progress event; ``check()`` returns True and
+    records on the registry iff the last beat is older than ``timeout_s``.
+    ``start()`` polls ``check`` on a daemon thread for production runs;
+    tests drive ``check()`` directly with an injected clock and never
+    sleep. Repeated checks during one stall flag once (edge-triggered) —
+    a new flag needs a beat in between.
+    """
+
+    def __init__(self, timeout_s: float, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None, name: str = "train"):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self._clock = clock
+        self._reg = registry if registry is not None else get_registry()
+        self._name = name
+        self._last_beat = clock()
+        self._flagged = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last_beat = self._clock()
+        if self._flagged:
+            self._flagged = False
+            self._reg.gauge(f"{self._name}_stalled",
+                            "1 while the loop is flagged stalled").set(0)
+
+    def check(self) -> bool:
+        age = self._clock() - self._last_beat
+        self._reg.gauge(
+            f"{self._name}_last_progress_age_s",
+            "seconds since the loop last made progress").set(age)
+        if age <= self.timeout_s:
+            return False
+        if not self._flagged:
+            self._flagged = True
+            self._reg.counter(f"{self._name}_stall_flags_total",
+                              "distinct stalls flagged").inc()
+            self._reg.gauge(f"{self._name}_stalled",
+                            "1 while the loop is flagged stalled").set(1)
+            warnings.warn(
+                f"{self._name} loop stalled: no progress for {age:.1f}s "
+                f"(timeout {self.timeout_s:.1f}s)", stacklevel=2)
+        return True
+
+    def start(self, poll_s: Optional[float] = None) -> "StallWatchdog":
+        if self._thread is not None:
+            return self
+        interval = poll_s if poll_s is not None else max(
+            self.timeout_s / 4.0, 0.05)
+
+        def loop():
+            while not self._stop.wait(interval):
+                self.check()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name=f"dcnn-{self._name}-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._stop = threading.Event()

@@ -33,13 +33,13 @@ snapshot under open-loop traffic) and ``BENCH_SERVE=1 python bench.py``
 """
 
 from .engine import InferenceEngine, serve_buckets
-from .batcher import DynamicBatcher, QueueFullError
+from .batcher import DynamicBatcher, QueueFullError, ShutdownError
 from .metrics import ServeMetrics
 from .traffic import open_loop
 
 __all__ = [
     "InferenceEngine", "serve_buckets",
-    "DynamicBatcher", "QueueFullError",
+    "DynamicBatcher", "QueueFullError", "ShutdownError",
     "ServeMetrics",
     "open_loop",
 ]

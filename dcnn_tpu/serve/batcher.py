@@ -15,9 +15,13 @@ scheduler) is a **batching window**: hold the first request at most
   ``max_batch`` samples, or the oldest request has waited ``max_wait_ms``,
   or the batcher is draining — pads it to the engine's nearest bucket,
   runs the pre-compiled session, and resolves per-request futures;
-- graceful teardown: :meth:`drain` stops intake and completes everything
-  already accepted; :meth:`shutdown` additionally cancels (non-drain) and
-  joins the thread.
+- graceful teardown with a **no-orphan guarantee**: :meth:`drain` stops
+  intake and completes everything already accepted; :meth:`shutdown`
+  with ``drain=False`` fails still-queued requests with
+  :class:`ShutdownError`; and a :meth:`drain` that trips its ``timeout``
+  fails every still-pending future the same way before raising — a caller
+  blocked on ``future.result()`` is *always* released, never left parked
+  on a future nobody will resolve.
 
 Determinism for tests: with ``start=False`` no thread runs and
 :meth:`step` dispatches synchronously; combined with an injectable
@@ -32,7 +36,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -44,6 +48,12 @@ from .metrics import ServeMetrics
 
 class QueueFullError(RuntimeError):
     """Backpressure: the bounded request queue is at capacity."""
+
+
+class ShutdownError(RuntimeError):
+    """The batcher shut down (or a timed drain gave up) before this
+    request could be served. Raised from the request's future — never
+    left forever-pending."""
 
 
 class _Request:
@@ -87,6 +97,9 @@ class DynamicBatcher:
         self._clock = clock
         self._q: deque = deque()
         self._rows = 0
+        # every accepted, not-yet-resolved future: the no-orphan guarantee's
+        # ledger (set ops are GIL-atomic; resolution paths discard)
+        self._accepted: set = set()
         self._cond = threading.Condition()
         self._closing = False
         self._thread: Optional[threading.Thread] = None
@@ -131,6 +144,7 @@ class DynamicBatcher:
             self._q.append(_Request(
                 x, n, single, fut, self._clock(),
                 span=tracer.begin("serve.queue", track="serve.queue", n=n)))
+            self._accepted.add(fut)
             self._rows += n
             self.metrics.record_submit(n)
             self.metrics.record_queue_depth(self._rows)
@@ -165,6 +179,7 @@ class DynamicBatcher:
                 # (set_result on it would otherwise poison the scatter)
                 if not req.future.set_running_or_notify_cancel():
                     tracer.end(req.span, cancelled=True)
+                    self._accepted.discard(req.future)
                     continue
                 tracer.end(req.span)  # queue residency: enqueue -> dispatch
                 rows += req.n
@@ -191,15 +206,24 @@ class DynamicBatcher:
             t_done = self._clock()
             off = 0
             for r in batch:
-                r.future.set_result(y[off] if r.single
-                                    else y[off:off + r.n])
-                self.metrics.record_done(t_done - r.t_submit, r.n)
+                try:
+                    r.future.set_result(y[off] if r.single
+                                        else y[off:off + r.n])
+                    self.metrics.record_done(t_done - r.t_submit, r.n)
+                except InvalidStateError:
+                    pass  # failed by a timed-out drain racing this dispatch
                 off += r.n
             self.metrics.record_batch(rows, padded.shape[0])
         except Exception as e:  # scatter the failure, don't kill the thread
             for r in batch:
                 if not r.future.done():
-                    r.future.set_exception(e)
+                    try:
+                        r.future.set_exception(e)
+                    except InvalidStateError:
+                        pass
+        finally:
+            for r in batch:
+                self._accepted.discard(r.future)
 
     def step(self, force: bool = True) -> int:
         """Synchronously dispatch one batch (``start=False`` mode and
@@ -232,17 +256,50 @@ class DynamicBatcher:
                 self._run(batch)
 
     # -- teardown --
+    def _fail_pending(self, exc: Exception) -> int:
+        """Resolve every still-pending accepted future with ``exc`` —
+        the no-orphan guarantee's last resort. Safe against races with a
+        dispatcher concurrently resolving the same futures (whoever sets
+        first wins; the loser's ``InvalidStateError`` is absorbed).
+        Returns how many futures this call actually failed."""
+        with self._cond:
+            queued = list(self._q)
+            self._q.clear()
+            self._rows = 0
+            pending = set(self._accepted)
+            self._accepted.clear()
+            self.metrics.record_queue_depth(0)
+        tracer = get_tracer()
+        failed = 0
+        for r in queued:
+            tracer.end(r.span, failed=type(exc).__name__)
+        for fut in pending:
+            try:
+                fut.set_exception(exc)
+                failed += 1
+            except InvalidStateError:
+                pass  # resolved (or cancelled) while we swept
+        return failed
+
     def drain(self, timeout: Optional[float] = None) -> None:
         """Stop accepting new requests; complete everything accepted.
         Threaded mode joins the dispatcher (it exits once empty);
-        ``start=False`` mode dispatches the backlog inline."""
+        ``start=False`` mode dispatches the backlog inline. If ``timeout``
+        trips, every still-pending future is failed with
+        :class:`ShutdownError` (never orphaned) and ``TimeoutError``
+        raises."""
         with self._cond:
             self._closing = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
-                raise TimeoutError(f"drain did not finish in {timeout}s")
+                n = self._fail_pending(ShutdownError(
+                    f"drain timed out after {timeout}s with requests "
+                    f"pending; the batcher is shutting down"))
+                raise TimeoutError(
+                    f"drain did not finish in {timeout}s "
+                    f"({n} pending request(s) failed with ShutdownError)")
             self._thread = None
         else:
             while self.step(force=True):
@@ -251,25 +308,35 @@ class DynamicBatcher:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """``drain=True``: :meth:`drain`. ``drain=False``: reject further
-        intake and cancel queued requests (their futures raise
-        ``CancelledError``)."""
+        intake and fail queued requests — their futures raise
+        :class:`ShutdownError` (a request someone is blocked on must
+        resolve, not vanish with the batcher)."""
         if drain:
             self.drain(timeout)
             return
+        exc = ShutdownError("batcher shut down without drain")
         with self._cond:
             self._closing = True
-            pending = list(self._q)
+            # pop the backlog under the lock so the dispatcher can't drain
+            # it; in-flight work (already popped) completes during join
+            queued = list(self._q)
             self._q.clear()
             self._rows = 0
+            for r in queued:
+                self._accepted.discard(r.future)
             self.metrics.record_queue_depth(0)
             self._cond.notify_all()
         tracer = get_tracer()
-        for r in pending:
-            r.future.cancel()
-            tracer.end(r.span, cancelled=True)
+        for r in queued:
+            try:
+                r.future.set_exception(exc)
+            except InvalidStateError:
+                pass  # caller cancelled it while queued
+            tracer.end(r.span, failed="ShutdownError")
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        self._fail_pending(exc)  # sweep any remainder: no future orphaned
 
     def __enter__(self) -> "DynamicBatcher":
         return self

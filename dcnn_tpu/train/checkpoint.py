@@ -12,6 +12,17 @@ Format: ``<dir>/model.json`` (model config + optimizer config + user
 metadata) and ``<dir>/arrays.msgpack`` (params/state/opt_state pytrees via
 flax.serialization). Loading rebuilds the model through the LayerFactory from
 JSON — the exact machinery a pipeline worker uses to materialize a stage.
+
+Durability: each file is committed atomically (tmp sibling + fsync +
+``os.replace`` — ``resilience/atomic.py``), so a preemption mid-save can
+never leave a torn, half-written file: the previous checkpoint's bytes
+survive intact until the instant a complete replacement lands. Arrays are
+replaced before the config that describes them, so the one cross-file crash
+window (between the two renames) yields new arrays + old config — identical
+in-run (the config doesn't change between epochs), and a *loud* template
+mismatch rather than silent corruption if the architecture changed. Runs
+that need step history, checksums, retention, or async saves use the v2
+layer on top: ``dcnn_tpu.resilience.CheckpointManager``.
 """
 
 from __future__ import annotations
@@ -25,6 +36,8 @@ from flax import serialization
 
 from ..nn.sequential import Sequential
 from ..optim.optimizers import Optimizer, OptimizerFactory
+from ..resilience import faults as _faults
+from ..resilience.atomic import write_file_atomic
 
 _ARRAYS = "arrays.msgpack"
 _MODEL = "model.json"
@@ -40,16 +53,19 @@ def save_checkpoint(path: str, model: Sequential, params, state, opt_state=None,
         "metadata": metadata or {},
         "has_opt_state": opt_state is not None,
     }
-    with open(os.path.join(path, _MODEL), "w", encoding="utf-8") as f:
-        json.dump(manifest, f, indent=2)
     tree = {"params": params, "state": state}
     if opt_state is not None:
         tree["opt_state"] = opt_state
-    with open(os.path.join(path, _ARRAYS), "wb") as f:
-        # to_bytes state-dict-ifies the tree (tuples → indexed dicts), which
-        # msgpack can carry; from_bytes restores against the typed template.
-        f.write(serialization.to_bytes(
-            jax.tree_util.tree_map(lambda x: jax.device_get(x), tree)))
+    # to_bytes state-dict-ifies the tree (tuples → indexed dicts), which
+    # msgpack can carry; from_bytes restores against the typed template.
+    array_bytes = serialization.to_bytes(
+        jax.tree_util.tree_map(lambda x: jax.device_get(x), tree))
+    # fault-injection point: a "preemption" here models dying mid-save,
+    # before anything replaced the previous checkpoint's files
+    _faults.trip("ckpt.write", path=path)
+    write_file_atomic(os.path.join(path, _ARRAYS), array_bytes)
+    write_file_atomic(os.path.join(path, _MODEL),
+                      json.dumps(manifest, indent=2).encode("utf-8"))
 
 
 def load_checkpoint(path: str, seed: int = 0,

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..core.config import ProfilerType, TrainingConfig
 from ..nn.sequential import Sequential
 from ..obs import get_registry, get_tracer
+from ..resilience import faults as _faults
 from ..ops.losses import get_loss, upcast_logits
 from ..ops.metrics import correct_count
 from ..optim.optimizers import Optimizer
@@ -65,7 +66,8 @@ def create_train_state(model: Sequential, optimizer: Optimizer, key: jax.Array,
 
 def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
                     num_microbatches: int = 1, donate: bool = True,
-                    jit: bool = True, reduce_axis: Optional[str] = None):
+                    jit: bool = True, reduce_axis: Optional[str] = None,
+                    guard: bool = False):
     """Returns jitted ``step(ts, x, y, rng, lr) -> (ts, loss, logits)``.
 
     With ``num_microbatches > 1`` the batch is split on the leading axis and
@@ -76,7 +78,14 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
     ``pmean`` grads, loss, and the updated layer state over before the
     optimizer update — the canonical data-parallel step; every DP wrapper
     reuses this instead of reimplementing fwd/bwd/update. Logits stay local
-    to the shard."""
+    to the shard.
+
+    ``guard=True``: the step additionally returns a scalar bool ``bad`` —
+    the in-graph non-finite detector (``~isfinite(loss) | ~isfinite(Σ‖g‖²)``)
+    — and when it fires the returned TrainState is the *incoming* one
+    (params/state/opt_state/step selected untouched via ``jnp.where``), so
+    a poisoned batch can never contaminate training state; host-side
+    policy (raise / skip / rollback) lives in ``resilience.StepGuard``."""
 
     def forward_loss(params, state, x, y, rng):
         logits, new_state = model.apply(params, state, x, training=True, rng=rng)
@@ -130,7 +139,19 @@ def make_train_step(model: Sequential, loss_fn: Callable, optimizer: Optimizer,
             # this equals an EMA of shard-mean statistics)
             new_state = jax.lax.pmean(new_state, reduce_axis)
         new_params, new_opt = optimizer.update(grads, ts.opt_state, ts.params, lr)
-        return (TrainState(new_params, new_state, new_opt, ts.step + 1), loss, logits)
+        if not guard:
+            return (TrainState(new_params, new_state, new_opt, ts.step + 1),
+                    loss, logits)
+        from ..resilience.guards import global_norm_sq
+        bad = jnp.logical_not(jnp.isfinite(loss)
+                              & jnp.isfinite(global_norm_sq(grads)))
+        keep = lambda new, old: jnp.where(bad, old, new)  # noqa: E731
+        guarded = TrainState(
+            jax.tree_util.tree_map(keep, new_params, ts.params),
+            jax.tree_util.tree_map(keep, new_state, ts.state),
+            jax.tree_util.tree_map(keep, new_opt, ts.opt_state),
+            jnp.where(bad, ts.step, ts.step + 1))
+        return guarded, loss, logits, bad
 
     if not jit:
         return step
@@ -242,8 +263,40 @@ class Trainer:
         self.scheduler = scheduler
         self.profiler = (LayerProfiler(self.config.profiler)
                          if self.config.profiler != ProfilerType.NONE else None)
+        # non-finite step guard (resilience/guards.py): "off" keeps the
+        # exact pre-guard graph; any policy compiles the guarded step that
+        # returns (and neutralizes) the bad flag in-graph
+        self._guard_on = self.config.nonfinite_policy != "off"
+        if self._guard_on:
+            if self.config.steps_per_dispatch > 1:
+                raise ValueError(
+                    "nonfinite_policy guards the per-batch step loop; with "
+                    "steps_per_dispatch > 1 losses never reach the host "
+                    "per-step — use steps_per_dispatch=1 or policy 'off'")
+            if (self.config.nonfinite_policy == "rollback"
+                    and not self.config.checkpoint_dir):
+                raise ValueError(
+                    "nonfinite_policy='rollback' needs checkpoint_dir set "
+                    "(and checkpoint_every > 0) so there is a checkpoint "
+                    "to roll back to — a rollback that can only abort is "
+                    "a delayed crash, not a recovery policy")
+            from ..resilience.guards import StepGuard
+            self.guard = StepGuard(self.config.nonfinite_policy,
+                                   rollback_after=self.config.rollback_after)
+        else:
+            self.guard = None
+        # periodic atomic checkpointing + resume (resilience/checkpoint.py)
+        if self.config.checkpoint_dir:
+            from ..resilience.checkpoint import CheckpointManager
+            self.checkpoints = CheckpointManager(
+                self.config.checkpoint_dir, keep=self.config.checkpoint_keep)
+        else:
+            self.checkpoints = None
+        self.watchdog = None  # created per fit() when stall_timeout_s > 0
+        self._global_step = 0
         self.train_step = make_train_step(model, self.loss_fn, optimizer,
-                                          self.config.num_microbatches)
+                                          self.config.num_microbatches,
+                                          guard=self._guard_on)
         # chunked fast path: one device dispatch per K train steps. The
         # loader must yield [K, B, ...] stacks (PrefetchLoader with
         # stage_batches=K); per-batch logits/accuracy are not materialized
@@ -274,9 +327,36 @@ class Trainer:
             return int(x.shape[0])
         return None
 
+    def _rollback(self, ts: TrainState) -> TrainState:
+        """'rollback' guard policy: restore training state from the newest
+        valid checkpoint (the run's state may already be poisoned — one
+        skipped step was not enough)."""
+        if self.checkpoints is None:
+            raise RuntimeError(
+                "nonfinite_policy='rollback' needs checkpoint_dir set so "
+                "there is a checkpoint to roll back to")
+        self.checkpoints.wait()  # queued async saves must land first
+        restored = self.checkpoints.restore_latest(seed=self.config.seed)
+        if restored is None:
+            raise RuntimeError(
+                f"rollback requested but no valid checkpoint under "
+                f"{self.checkpoints.directory}")
+        print(f"  guard rollback: restored checkpoint step {restored.step} "
+              f"from {restored.path}", flush=True)
+        return TrainState(
+            restored.params, restored.state, restored.opt_state,
+            jnp.asarray(restored.metadata.get("global_step", 0), jnp.int32))
+
     def train_epoch(self, ts: TrainState, loader, rng: jax.Array,
                     epoch: int = 0) -> Tuple[TrainState, float, float]:
         from ..data.device_dataset import DeviceDataset, ShardedDeviceDataset
+        if isinstance(loader, (DeviceDataset, ShardedDeviceDataset)) \
+                and self.guard is not None:
+            raise ValueError(
+                "nonfinite_policy guards the per-batch step loop; resident "
+                "datasets run whole epochs in one dispatch (losses never "
+                "reach the host per-step) — use a host loader or policy "
+                "'off'")
         if isinstance(loader, ShardedDeviceDataset):
             return self._train_epoch_resident(ts, loader, rng, epoch, dp=True)
         if isinstance(loader, DeviceDataset):
@@ -289,11 +369,39 @@ class Trainer:
         for bi, (x, y) in enumerate(loader):
             x, y = jnp.asarray(x), jnp.asarray(y)
             step_rng = jax.random.fold_in(rng, bi)
+            self._global_step += 1
+            if self.watchdog is not None:
+                self.watchdog.beat()
+            if _faults.active() is not None:
+                # fault harness: an armed "train.nonfinite_input" poisons
+                # this batch so loss/grads go NaN (same shape/dtype — no
+                # retrace), proving the guard path end to end; armed as an
+                # InjectedCrash it kills the run here instead (the
+                # mid-epoch-preemption simulation resume tests restart from)
+                try:
+                    _faults.trip("train.nonfinite_input",
+                                 step=self._global_step)
+                except _faults.InjectedCrash:
+                    raise
+                except _faults.InjectedFault:
+                    x = jnp.full_like(x, jnp.nan)
             # the float(loss)/correct_count reads inside the span block on
             # the device result, so step spans tile the epoch wall truthfully
             with tracer.span("train.step", track="train", epoch=epoch,
                              batch=bi):
-                ts, loss, logits = self.train_step(ts, x, y, step_rng, self.lr)
+                if self.guard is not None:
+                    ts, loss, logits, bad = self.train_step(
+                        ts, x, y, step_rng, self.lr)
+                    action = self.guard.observe(
+                        self._global_step, bool(bad), float(loss))
+                    if action == "rollback":
+                        ts = self._rollback(ts)
+                        continue  # skipped-step metrics excluded below too
+                    if action == "skipped":
+                        continue  # NaN loss must not poison the epoch mean
+                else:
+                    ts, loss, logits = self.train_step(
+                        ts, x, y, step_rng, self.lr)
                 total_loss += float(loss) * x.shape[0]
                 total_correct += int(correct_count(logits, y))
             total_n += x.shape[0]
@@ -302,12 +410,14 @@ class Trainer:
                     and self.config.scheduler_step == "batch"):
                 # per-batch cadence: what OneCycleLR/WarmupCosine are sized
                 # for (total_steps = epochs * batches_per_epoch); the metric
-                # is the running train loss (val loss doesn't exist mid-epoch)
-                self.lr = self.scheduler.step(total_loss / total_n)
+                # is the running train loss (val loss doesn't exist mid-epoch;
+                # max() guards an all-steps-skipped start under the guard)
+                self.lr = self.scheduler.step(total_loss / max(total_n, 1))
             if self.config.progress_interval and (bi + 1) % self.config.progress_interval == 0:
                 dt = time.perf_counter() - t0
-                print(f"  epoch {epoch} batch {bi + 1}: loss {total_loss / total_n:.4f} "
-                      f"acc {total_correct / total_n:.4f} "
+                n = max(total_n, 1)
+                print(f"  epoch {epoch} batch {bi + 1}: loss {total_loss / n:.4f} "
+                      f"acc {total_correct / n:.4f} "
                       f"({total_n / dt:.1f} samples/s)", flush=True)
         return ts, (total_loss / max(total_n, 1)), (total_correct / max(total_n, 1))
 
@@ -379,6 +489,8 @@ class Trainer:
         total_loss, total_n = 0.0, 0
         t0 = time.perf_counter()
         for ci, (xs, ys) in enumerate(loader):
+            if self.watchdog is not None:
+                self.watchdog.beat()
             xs, ys = jnp.asarray(xs), jnp.asarray(ys)
             if xs.ndim != sample_ndim + 2:
                 raise ValueError(
@@ -428,7 +540,49 @@ class Trainer:
         best_val = -1.0
         tracer = get_tracer()
         reg = get_registry()
-        for epoch in range(1, epochs + 1):
+        start_epoch = 1
+        if self.checkpoints is not None and cfg.resume == "auto":
+            # resume contract (docs/reliability.md): epoch rng is
+            # fold_in(PRNGKey(seed), epoch) and loaders shuffle by epoch, so
+            # restarting at the restored epoch+1 with restored
+            # params/state/opt_state/lr replays the exact uninterrupted
+            # loss trajectory (metric-driven scheduler internals are the one
+            # documented exception — they see the restored history only)
+            restored = self.checkpoints.restore_latest(seed=cfg.seed)
+            if restored is not None:
+                md = restored.metadata
+                ts = TrainState(
+                    restored.params, restored.state, restored.opt_state,
+                    jnp.asarray(md.get("global_step", 0), jnp.int32))
+                start_epoch = restored.step + 1
+                self.lr = md.get("lr", self.lr)
+                self.history = md.get("history", self.history) or []
+                self._global_step = int(md.get("global_step", 0))
+                best_val = md.get("best_val", -1.0)
+                print(f"resumed from checkpoint step {restored.step} "
+                      f"({restored.path}); continuing at epoch {start_epoch}",
+                      flush=True)
+        if cfg.stall_timeout_s > 0:
+            from ..resilience.guards import StallWatchdog
+            self.watchdog = StallWatchdog(cfg.stall_timeout_s).start()
+        try:
+            return self._fit_loop(ts, train_loader, val_loader, epochs,
+                                  start_epoch, rng, best_val, tracer, reg)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
+                self.watchdog = None
+            if self.checkpoints is not None:
+                # abandoning queued async saves would silently lose the
+                # newest checkpoint; surface any saver-thread failure here
+                self.checkpoints.wait()
+
+    def _fit_loop(self, ts, train_loader, val_loader, epochs, start_epoch,
+                  rng, best_val, tracer, reg) -> TrainState:
+        cfg = self.config
+        for epoch in range(start_epoch, epochs + 1):
+            if self.watchdog is not None:
+                self.watchdog.beat()
             if hasattr(train_loader, "shuffle"):
                 train_loader.shuffle(epoch)
             epoch_rng = jax.random.fold_in(rng, epoch)
@@ -527,6 +681,23 @@ class Trainer:
                 self.lr = self.scheduler.step(val_loss if val_loss is not None else train_loss)
             elif cfg.lr_decay_factor != 1.0 and epoch % cfg.lr_decay_interval == 0:
                 self.lr *= cfg.lr_decay_factor
+
+            # periodic preemption-safe checkpoint (resilience/checkpoint.py),
+            # AFTER the lr schedule so the saved lr is exactly what epoch+1
+            # trains with — resume replays the uninterrupted run bit-exact.
+            # Async mode's only step-loop cost is the device_get snapshot.
+            if (self.checkpoints is not None and cfg.checkpoint_every
+                    and epoch % cfg.checkpoint_every == 0):
+                md = {"epoch": epoch, "lr": float(self.lr),
+                      "history": self.history, "best_val": best_val,
+                      "global_step": self._global_step}
+                # fail fast on an earlier save that already failed — a run
+                # whose checkpoints silently rot isn't preemption-safe
+                self.checkpoints.check()
+                save = (self.checkpoints.save_async if cfg.checkpoint_async
+                        else self.checkpoints.save)
+                save(epoch, self.model, ts.params, ts.state, ts.opt_state,
+                     self.optimizer, md)
         return ts
 
 

@@ -209,6 +209,11 @@ def test_byte_shuffle_roundtrip_and_layout(rng):
 
 @requires_native
 def test_shuffle_zstd_codec_beats_plain_zstd_on_floats(rng):
+    # the codec is an optional-dependency wrapper: without the zstandard
+    # wheel the constructor raises by design — that's an environment
+    # without the feature, not a shuffle-filter regression, so skip (the
+    # shuffle filter itself is covered dependency-free above)
+    pytest.importorskip("zstandard")
     from dcnn_tpu.utils.compression import (
         MetaCompressor, ShuffleZstdCompressor, ZstdCompressor)
 

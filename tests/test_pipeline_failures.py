@@ -188,6 +188,102 @@ def test_strict_join_still_rejects_protocol_errors():
         co._join("PARAMETERS_UPDATED", 1)
 
 
+def test_connect_retries_flaky_socket_with_backoff():
+    """ISSUE 4 satellite: comm.connect rides the shared bounded-backoff
+    primitive — a flaky listener (refuses k times, then accepts) is
+    survived, the delays grow exponentially (jittered, capped), and the
+    attempts land on the obs registry. Sleep-free via injected
+    sleep/clock."""
+    from dcnn_tpu.obs import get_registry
+    from dcnn_tpu.parallel import comm
+
+    class FakeSock:
+        def setsockopt(self, *a):
+            pass
+
+        def settimeout(self, t):
+            self.timeout = t
+
+    flaky = {"left": 3}
+    dialed = []
+
+    def fake_create_connection(addr, timeout=None):
+        dialed.append(addr)
+        if flaky["left"] > 0:
+            flaky["left"] -= 1
+            raise ConnectionRefusedError("worker still importing jax")
+        return FakeSock()
+
+    sleeps = []
+    t = [0.0]
+    real = comm.socket.create_connection
+    comm.socket.create_connection = fake_create_connection
+    try:
+        reg = get_registry()
+        before = reg.counter("pipeline_connect_retry_attempts_total").value
+        chan = comm.connect("10.0.0.7", 5555, timeout=30.0, delay=0.1,
+                            sleep=lambda s: (sleeps.append(s),
+                                             t.__setitem__(0, t[0] + s)),
+                            clock=lambda: t[0])
+        assert isinstance(chan._sock, FakeSock)
+        assert dialed == [("10.0.0.7", 5555)] * 4          # 3 failures + 1 ok
+        assert len(sleeps) == 3
+        assert reg.counter(
+            "pipeline_connect_retry_attempts_total").value == before + 3
+        # bounded exponential with equal jitter: each delay in [d/2, d),
+        # d = min(cap, base * 2**i)
+        for i, s in enumerate(sleeps):
+            d = min(2.0, 0.1 * 2 ** i)
+            assert d / 2 <= s <= d, (i, s)
+    finally:
+        comm.socket.create_connection = real
+
+
+def test_connect_gives_up_after_deadline_with_clear_error():
+    from dcnn_tpu.parallel import comm
+
+    def always_down(addr, timeout=None):
+        raise ConnectionRefusedError("nobody home")
+
+    t = [0.0]
+    real = comm.socket.create_connection
+    comm.socket.create_connection = always_down
+    try:
+        with pytest.raises(ConnectionError, match="cannot connect.*9:9999"):
+            comm.connect("9", 9999, timeout=5.0, delay=0.5,
+                         sleep=lambda s: t.__setitem__(0, t[0] + s),
+                         clock=lambda: t[0])
+        assert t[0] <= 5.0 + 2.0   # deadline bounded the loop, not attempts
+    finally:
+        comm.socket.create_connection = real
+
+
+def test_connect_fault_point_drives_retry_then_recovers():
+    """The comm.connect FaultPlan point: armed to fail twice, the third
+    attempt succeeds — the deterministic-retry idiom the cookbook
+    documents."""
+    from dcnn_tpu.parallel import comm
+    from dcnn_tpu.resilience import FaultPlan
+
+    class FakeSock:
+        def setsockopt(self, *a):
+            pass
+
+        def settimeout(self, t):
+            pass
+
+    real = comm.socket.create_connection
+    comm.socket.create_connection = lambda addr, timeout=None: FakeSock()
+    try:
+        with FaultPlan().arm("comm.connect", times=2, exc=OSError) as plan:
+            chan = comm.connect("w", 7777, timeout=10.0, delay=0.01,
+                                sleep=lambda s: None)
+            assert isinstance(chan._sock, FakeSock)
+            assert plan.count("comm.connect") == 3
+    finally:
+        comm.socket.create_connection = real
+
+
 def test_stale_profiling_reply_is_dropped():
     """A PROFILING_REPORT from a timed-out earlier round (wrong/absent nonce)
     must be dropped at consumption, never satisfying a later join or leaking

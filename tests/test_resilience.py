@@ -1,0 +1,781 @@
+"""Fault-tolerance subsystem tests (dcnn_tpu/resilience/; ISSUE 4).
+
+Every claim the resilience layer makes is proven here under injected
+faults, not assumed:
+
+- atomicity: a crash at ANY FaultPlan point in a checkpoint save leaves
+  ``restore_latest`` a checksum-valid checkpoint (previous one for
+  pre-commit crashes, the new one for post-commit), and the v1
+  ``save_checkpoint`` torn-write regression stays fixed;
+- bit-exact resume: kill mid-run, restart with ``resume="auto"``, and the
+  remaining loss trajectory equals an uninterrupted reference run
+  float-for-float (digits28 fixture — the acceptance criterion);
+- the non-finite guard: an injected NaN at step j with ``skip_step``
+  leaves params/opt_state bit-identical to step j-1 and counts it; with
+  ``raise`` it aborts naming the step;
+- async saves never block the step loop on disk (gated fake writer: the
+  training thread keeps stepping while the filesystem is wedged);
+- the shared retry primitive's backoff schedule is exact (seeded rng,
+  injected clock/sleep — nothing here sleeps for real).
+"""
+
+import json
+import os
+import random
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcnn_tpu.core.config import TrainingConfig
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.obs import get_registry
+from dcnn_tpu.optim import Adam, SGD
+from dcnn_tpu.ops.losses import get_loss
+from dcnn_tpu.resilience import (
+    CheckpointManager, FaultPlan, InjectedCrash, InjectedFault, NonFiniteError,
+    StallWatchdog, StepGuard, backoff_delays, restore_latest, retry_call,
+    retriable,
+)
+from dcnn_tpu.resilience import faults
+from dcnn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+from dcnn_tpu.train.trainer import (
+    Trainer, create_train_state, make_train_step)
+
+CE = get_loss("softmax_crossentropy")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.clear()  # a failing test must not leave a plan armed for others
+
+
+def _model(name="rsl"):
+    return (SequentialBuilder(name)
+            .input((1, 8, 8))
+            .conv2d(2, 3, 1, 1).batchnorm().activation("relu")
+            .flatten().dense(4)
+            .build())
+
+
+def _batch(n=8, seed=0, poison=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1, 8, 8)).astype(np.float32)
+    if poison:
+        x[:] = np.nan
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _host_copy(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.array(jax.device_get(a), copy=True), tree)
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, z in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(z))
+
+
+# ===================================================== FaultPlan semantics
+
+def test_fault_plan_arming_at_times_and_counts():
+    plan = FaultPlan(seed=0)
+    plan.arm("p", at=1, times=1)  # exactly the second invocation fires
+    with plan:
+        faults.trip("p")         # invocation 0: below at=1 -> no fire
+        with pytest.raises(InjectedFault) as ei:
+            faults.trip("p", step=7)
+        assert ei.value.invocation == 1 and ei.value.context["step"] == 7
+        faults.trip("p")         # times=1 consumed
+    assert plan.count("p") == 3
+    # times= disarms after firing
+    plan2 = FaultPlan().arm("q", times=2, exc=OSError)
+    with plan2:
+        for _ in range(2):
+            with pytest.raises(OSError):
+                faults.trip("q")
+        faults.trip("q")         # disarmed
+    # cleared: no active plan, trip is free
+    faults.trip("p")
+
+
+def test_fault_plan_bit_flip_is_seeded_and_corrupts(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(64)))
+    off1 = FaultPlan(seed=5).bit_flip(str(p))
+    p.write_bytes(bytes(range(64)))
+    off2 = FaultPlan(seed=5).bit_flip(str(p))
+    assert off1 == off2                       # deterministic from the seed
+    assert p.read_bytes() != bytes(range(64))
+
+
+# ============================================================== retry.py
+
+def test_retry_backoff_schedule_exact_and_bounded():
+    sleeps, calls = [], []
+    expected = list(backoff_delays(4, base=0.1, cap=0.5,
+                                   rng=random.Random(3)))
+    assert all(d <= 0.5 for d in expected)          # cap respected
+    assert expected[0] >= 0.05                      # equal jitter >= d/2
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 5:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, attempts=5, base=0.1, cap=0.5,
+                      rng=random.Random(3), sleep=sleeps.append,
+                      name="t_exact") == "ok"
+    assert sleeps == expected                       # exact schedule
+
+    # attempts exhausted: the last exception re-raises unwrapped
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   attempts=3, base=0.01, sleep=lambda s: None,
+                   name="t_exhaust")
+
+
+def test_retry_deadline_and_counters():
+    reg = get_registry()
+    before = reg.counter("retry_attempts_total").value
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        t[0] += s
+
+    calls = []
+
+    def never():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(never, attempts=100, base=1.0, cap=1.0, timeout=3.0,
+                   sleep=sleep, clock=clock, rng=random.Random(0),
+                   name="t_deadline")
+    assert len(calls) < 100          # the deadline, not attempts, bounded it
+    assert t[0] <= 3.0 + 1.0
+    assert reg.counter("retry_attempts_total").value > before
+    assert reg.counter("t_deadline_retry_attempts_total").value == \
+        len(calls) - 1
+
+    # non-matching exceptions propagate immediately, no retry
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("no")),
+                   attempts=5, retry_on=(OSError,), sleep=lambda s: None)
+
+
+def test_retriable_decorator():
+    calls = []
+
+    @retriable(attempts=3, base=0.01, sleep=lambda s: None, name="t_deco")
+    def sometimes(v):
+        calls.append(v)
+        if len(calls) < 2:
+            raise OSError("once")
+        return v * 2
+
+    assert sometimes(21) == 42
+    assert calls == [21, 21]
+
+
+# ===================================== v1 save_checkpoint torn-write fix
+
+def test_v1_crash_mid_save_leaves_previous_checkpoint_loadable(tmp_path):
+    """Regression (ISSUE 4 satellite 1): the old open()+write left a torn
+    arrays.msgpack on preemption; now a simulated crash mid-save must leave
+    the PREVIOUS checkpoint fully loadable."""
+    d = str(tmp_path / "ck")
+    model = _model()
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    save_checkpoint(d, model, ts.params, ts.state, ts.opt_state, opt,
+                    {"epoch": 1})
+    ref = _host_copy({"p": ts.params, "o": ts.opt_state})
+
+    step = make_train_step(model, CE, opt, donate=False)
+    x, y = _batch()
+    ts2, *_ = step(ts, x, y, jax.random.PRNGKey(1), 1e-3)
+
+    with FaultPlan().arm("ckpt.write", exc=InjectedCrash):
+        with pytest.raises(InjectedCrash):
+            save_checkpoint(d, model, ts2.params, ts2.state, ts2.opt_state,
+                            opt, {"epoch": 2})
+    _, params, _, opt_state, _, md = load_checkpoint(d)
+    _assert_trees_equal(ref["p"], params)
+    _assert_trees_equal(ref["o"], opt_state)
+    assert md["epoch"] == 1
+    # and no torn tmp file shadows the real ones
+    assert sorted(f for f in os.listdir(d) if not f.startswith(".")) == \
+        ["arrays.msgpack", "model.json"]
+
+
+# ==================================================== CheckpointManager v2
+
+def _mgr_state(seed=0):
+    model = _model()
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(seed))
+    return model, opt, ts
+
+
+def test_manager_roundtrip_manifest_and_retention(tmp_path):
+    d = str(tmp_path)
+    model, opt, ts = _mgr_state()
+    with CheckpointManager(d, keep=2) as cm:
+        for s in (1, 2, 3):
+            cm.save(s, model, ts.params, ts.state, ts.opt_state, opt,
+                    {"epoch": s})
+        assert sorted(os.listdir(d)) == ["ckpt-00000002", "ckpt-00000003"]
+        r = cm.restore_latest()
+    assert r.step == 3 and r.metadata == {"epoch": 3}
+    _assert_trees_equal(ts.params, r.params)
+    _assert_trees_equal(ts.opt_state, r.opt_state)
+    man = json.loads(open(os.path.join(r.path, "MANIFEST.json")).read())
+    assert man["step"] == 3
+    assert set(man["files"]) == {"model.json", "arrays.msgpack"}
+    # duplicate steps are immutable
+    with CheckpointManager(d, keep=2) as cm2, pytest.raises(FileExistsError):
+        cm2.save(3, model, ts.params, ts.state, ts.opt_state, opt)
+
+
+@pytest.mark.parametrize("point,survivor", [
+    ("ckpt.write", 1),          # crash mid-stage: files partial in tmp
+    ("ckpt.before_rename", 1),  # staged + manifested, never committed
+    ("ckpt.after_rename", 2),   # committed: the NEW checkpoint is the truth
+])
+def test_crash_recovery_invariant_every_crash_point(tmp_path, point,
+                                                    survivor):
+    """Acceptance criterion: for EVERY crash point in a save,
+    restore_latest returns a checksum-valid checkpoint — the previous one
+    when the crash hit before the commit rename, the new one after."""
+    d = str(tmp_path)
+    model, opt, ts = _mgr_state()
+    with CheckpointManager(d, keep=3) as cm:
+        cm.save(1, model, ts.params, ts.state, ts.opt_state, opt,
+                {"epoch": 1})
+        with FaultPlan().arm(point, exc=InjectedCrash):
+            with pytest.raises(InjectedCrash):
+                cm.save(2, model, ts.params, ts.state, ts.opt_state, opt,
+                        {"epoch": 2})
+    # "restart": a fresh manager sweeps stale tmp dirs, restore scans
+    with CheckpointManager(d, keep=3) as cm2:
+        r = cm2.restore_latest()
+        assert r is not None and r.step == survivor
+        assert not [f for f in os.listdir(d) if f.startswith("tmp-")]
+    _assert_trees_equal(ts.params, r.params)
+
+
+def test_restore_skips_bit_flipped_checkpoint_to_newest_valid(tmp_path):
+    d = str(tmp_path)
+    model, opt, ts = _mgr_state()
+    reg = get_registry()
+    before = reg.counter("ckpt_restore_skipped_total").value
+    with CheckpointManager(d, keep=3) as cm:
+        cm.save(1, model, ts.params, ts.state, ts.opt_state, opt)
+        cm.save(2, model, ts.params, ts.state, ts.opt_state, opt)
+        FaultPlan(seed=7).bit_flip(
+            os.path.join(d, "ckpt-00000002", "arrays.msgpack"))
+        with pytest.warns(UserWarning, match="torn/corrupt"):
+            r = cm.restore_latest()
+    assert r.step == 1
+    assert reg.counter("ckpt_restore_skipped_total").value == before + 1
+    # both files corrupted -> nothing valid -> None
+    FaultPlan(seed=8).bit_flip(
+        os.path.join(d, "ckpt-00000001", "model.json"))
+    with pytest.warns(UserWarning):
+        assert restore_latest(d) is None
+
+
+def test_corrupt_checkpoint_is_quarantined_not_blocking_resave(tmp_path):
+    """Review fix: a checksum-failed newest checkpoint must be quarantined
+    (renamed corrupt-*) so the resumed run can commit that step number
+    again instead of dying on FileExistsError."""
+    d = str(tmp_path)
+    model, opt, ts = _mgr_state()
+    with CheckpointManager(d, keep=3) as cm:
+        cm.save(1, model, ts.params, ts.state, ts.opt_state, opt)
+        cm.save(2, model, ts.params, ts.state, ts.opt_state, opt)
+        FaultPlan(seed=9).bit_flip(
+            os.path.join(d, "ckpt-00000002", "arrays.msgpack"))
+        with pytest.warns(UserWarning, match="quarantined"):
+            r = cm.restore_latest()
+        assert r.step == 1
+        assert any(n.startswith("corrupt-ckpt-00000002")
+                   for n in os.listdir(d))
+        # the recovery path's first save: same step number, no collision
+        cm.save(2, model, ts.params, ts.state, ts.opt_state, opt)
+        assert cm.restore_latest().step == 2
+    # a fresh manager (restart) sweeps the quarantine litter
+    with CheckpointManager(d, keep=3):
+        assert not [n for n in os.listdir(d) if n.startswith("corrupt-")]
+
+
+def test_async_check_nonblocking_probe(tmp_path):
+    """Review fix: check() is the per-epoch non-blocking probe — a failed
+    async save raises at the NEXT checkpoint cadence (the Trainer calls it
+    before every save), not after the last epoch."""
+    model, opt, ts = _mgr_state()
+    gate = threading.Event()
+
+    def broken(path, data):
+        if not gate.wait(timeout=30):
+            raise TimeoutError("gate never released")
+        raise OSError("quota exceeded")
+
+    cm = CheckpointManager(str(tmp_path), keep=2, io_write=broken)
+    fut = cm.save_async(1, model, ts.params, ts.state, ts.opt_state, opt)
+    cm.check()   # save still in flight (gated): probe keeps it, no raise
+    gate.set()
+    assert isinstance(fut.exception(timeout=30), OSError)  # non-raising wait
+    with pytest.raises(OSError, match="quota"):
+        cm.check()
+    cm.check()   # inspected futures are dropped: no double-raise
+    cm.close()
+
+
+def test_async_metadata_is_frozen_at_save_time(tmp_path):
+    """Review fix: metadata is deep-frozen on the calling thread — the
+    Trainer keeps appending to its history list while the saver thread
+    serializes, and the checkpoint must carry the list as it was at save
+    time."""
+    model, opt, ts = _mgr_state()
+    gate = threading.Event()
+
+    def gated_write(path, data):
+        if not gate.wait(timeout=30):
+            raise TimeoutError("gate never released")
+        with open(path, "wb") as f:
+            f.write(data)
+
+    cm = CheckpointManager(str(tmp_path), keep=2, io_write=gated_write)
+    history = [{"epoch": 1, "loss": 0.5}]
+    cm.save_async(1, model, ts.params, ts.state, ts.opt_state, opt,
+                  {"history": history})
+    history.append({"epoch": 2, "loss": 0.25})   # mutate while save parked
+    gate.set()
+    cm.wait(timeout=30)
+    cm.close()
+    r = restore_latest(str(tmp_path))
+    assert r.metadata["history"] == [{"epoch": 1, "loss": 0.5}]
+
+
+def test_rollback_policy_requires_checkpoint_dir():
+    cfg = TrainingConfig(nonfinite_policy="rollback", checkpoint_dir=None)
+    with pytest.raises(ValueError, match="rollback.*checkpoint_dir"):
+        Trainer(_model("nodir"), Adam(1e-3), "softmax_crossentropy",
+                config=cfg)
+
+
+def test_retry_if_predicate_blocks_permanent_errors():
+    class FakeHTTPError(OSError):
+        def __init__(self, code):
+            super().__init__(f"HTTP {code}")
+            self.code = code
+
+    calls = []
+
+    def dead_mirror():
+        calls.append(1)
+        raise FakeHTTPError(404)
+
+    transient = lambda e: getattr(e, "code", None) not in range(400, 500)
+    with pytest.raises(FakeHTTPError):
+        retry_call(dead_mirror, attempts=4, base=0.01,
+                   retry_if=transient, sleep=lambda s: None, name="t_perm")
+    assert len(calls) == 1      # permanent: failed immediately, no retries
+
+
+def test_restore_latest_empty_and_missing_dir(tmp_path):
+    assert restore_latest(str(tmp_path)) is None
+    assert restore_latest(str(tmp_path / "never_made")) is None
+
+
+def test_async_save_never_blocks_on_slow_filesystem(tmp_path):
+    """Acceptance criterion: the step loop's save cost is the device_get
+    snapshot only. With the filesystem WEDGED (writer gated on an event
+    that is not set), save_async must return and training must keep
+    stepping; releasing the gate commits the checkpoint."""
+    d = str(tmp_path)
+    model, opt, ts = _mgr_state()
+    gate = threading.Event()
+    wrote = []
+
+    def gated_write(path, data):
+        if not gate.wait(timeout=30):
+            raise TimeoutError("test gate never released")
+        wrote.append(os.path.basename(path))
+        with open(path, "wb") as f:
+            f.write(data)
+
+    cm = CheckpointManager(d, keep=2, io_write=gated_write)
+    fut = cm.save_async(1, model, ts.params, ts.state, ts.opt_state, opt,
+                        {"epoch": 1})
+    # filesystem is hung, yet the training thread is free: run real steps
+    step = make_train_step(model, CE, opt, donate=False)
+    x, y = _batch()
+    for i in range(3):
+        ts, loss, _ = step(ts, x, y, jax.random.PRNGKey(i), 1e-3)
+        assert np.isfinite(float(loss))
+    assert not fut.done()            # the save is *still* parked on disk I/O
+    assert cm.latest_step() is None  # nothing committed yet
+    gate.set()
+    cm.wait(timeout=30)
+    assert fut.result(timeout=0).endswith("ckpt-00000001")
+    assert cm.latest_step() == 1
+    assert wrote[-1] == "MANIFEST.json"   # manifest is written last
+    cm.close()
+
+
+def test_async_save_failure_surfaces_in_wait(tmp_path):
+    model, opt, ts = _mgr_state()
+
+    def broken_write(path, data):
+        raise OSError("disk full")
+
+    cm = CheckpointManager(str(tmp_path), keep=2, io_write=broken_write)
+    cm.save_async(1, model, ts.params, ts.state, ts.opt_state, opt)
+    with pytest.raises(OSError, match="disk full"):
+        cm.wait(timeout=30)
+    cm.close()
+    assert cm.latest_step() is None
+    # a failed stage must not leave tmp litter
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.startswith("tmp-")]
+
+
+# ========================================================== step guards
+
+def test_guarded_step_skip_is_bit_identical_to_previous_step():
+    """Acceptance criterion: a NaN step under skip_step leaves
+    params/opt_state bit-identical to step j-1 and counts the skip."""
+    model, opt, ts = _mgr_state()
+    step = make_train_step(model, CE, opt, guard=True, donate=False)
+    x, y = _batch()
+    ts, loss, _, bad = step(ts, x, y, jax.random.PRNGKey(1), 1e-3)
+    assert not bool(bad) and np.isfinite(float(loss))
+    ref = _host_copy({"p": ts.params, "o": ts.opt_state, "s": ts.state})
+    step_before = int(ts.step)
+
+    xp, yp = _batch(poison=True)
+    ts2, loss2, _, bad2 = step(ts, xp, yp, jax.random.PRNGKey(2), 1e-3)
+    assert bool(bad2) and not np.isfinite(float(loss2))
+    _assert_trees_equal(ref["p"], ts2.params)
+    _assert_trees_equal(ref["o"], ts2.opt_state)
+    _assert_trees_equal(ref["s"], ts2.state)
+    assert int(ts2.step) == step_before     # the step did not count
+
+    reg = get_registry()
+    before = reg.counter("train_skipped_steps").value
+    guard = StepGuard("skip_step")
+    with pytest.warns(UserWarning, match="skipped"):
+        assert guard.observe(7, True) == "skipped"
+    assert reg.counter("train_skipped_steps").value == before + 1
+    assert guard.observe(8, False) == "ok"
+    assert guard.consecutive_bad == 0
+
+
+def test_guard_raise_policy_names_the_step():
+    guard = StepGuard("raise")
+    with pytest.raises(NonFiniteError, match="step 41"):
+        guard.observe(41, True, loss=float("nan"))
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")  # every skip warns by design
+def test_guard_rollback_after_n_consecutive():
+    guard = StepGuard("rollback", rollback_after=3)
+    assert guard.observe(1, True) == "skipped"
+    assert guard.observe(2, True) == "skipped"
+    assert guard.observe(3, True) == "rollback"
+    assert guard.consecutive_bad == 0       # reset after rollback
+    assert guard.observe(4, True) == "skipped"
+    guard.observe(5, False)
+    assert guard.observe(6, True) == "skipped"  # streak broken by good step
+
+
+def test_step_guard_validation():
+    with pytest.raises(ValueError, match="nonfinite_policy"):
+        StepGuard("explode")
+    with pytest.raises(ValueError, match="rollback_after"):
+        StepGuard("rollback", rollback_after=0)
+
+
+def test_stall_watchdog_flags_via_registry_sleep_free():
+    t = [0.0]
+    reg = get_registry()
+    wd = StallWatchdog(10.0, clock=lambda: t[0], registry=reg)
+    before = reg.counter("train_stall_flags_total").value
+    assert not wd.check()
+    t[0] = 9.0
+    assert not wd.check()
+    t[0] = 11.0
+    with pytest.warns(UserWarning, match="stalled"):
+        assert wd.check()
+    assert wd.check()                        # still stalled, flagged once
+    assert reg.counter("train_stall_flags_total").value == before + 1
+    assert reg.gauge("train_stalled").value == 1
+    t[0] = 12.0
+    wd.beat()
+    assert reg.gauge("train_stalled").value == 0
+    assert not wd.check()
+
+
+# ============================================ Trainer-level guard wiring
+
+def _loader(n=32, seed=0):
+    from dcnn_tpu.data import SyntheticClassificationLoader
+    ld = SyntheticClassificationLoader(n, (1, 8, 8), 4, batch_size=8,
+                                       seed=seed)
+    ld.load_data()
+    return ld
+
+
+def test_trainer_skip_step_policy_survives_injected_nan():
+    cfg = TrainingConfig(learning_rate=1e-3, snapshot_dir=None,
+                        nonfinite_policy="skip_step", progress_interval=0)
+    model = _model("guarded")
+    opt = Adam(1e-3)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    reg = get_registry()
+    before = reg.counter("train_skipped_steps").value
+    with FaultPlan().arm("train.nonfinite_input", at=2, times=1):
+        with pytest.warns(UserWarning, match="skipped"):
+            ts = trainer.fit(ts, _loader(), epochs=1)
+    assert reg.counter("train_skipped_steps").value == before + 1
+    assert trainer.guard.total_skipped == 1
+    assert np.isfinite(trainer.history[-1]["train_loss"])
+    # and params came out finite: the NaN batch never touched state
+    for leaf in jax.tree_util.tree_leaves(ts.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_trainer_raise_policy_aborts_naming_step():
+    cfg = TrainingConfig(learning_rate=1e-3, snapshot_dir=None,
+                        nonfinite_policy="raise", progress_interval=0)
+    model = _model("raising")
+    opt = Adam(1e-3)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    with FaultPlan().arm("train.nonfinite_input", at=1, times=1):
+        with pytest.raises(NonFiniteError, match="step 2"):
+            trainer.fit(ts, _loader(), epochs=1)
+
+
+def test_trainer_rollback_policy_restores_checkpoint(tmp_path):
+    cfg = TrainingConfig(learning_rate=1e-3, snapshot_dir=None,
+                        nonfinite_policy="rollback", rollback_after=2,
+                        checkpoint_dir=str(tmp_path), checkpoint_every=1,
+                        checkpoint_async=False, progress_interval=0)
+    model = _model("rollback")
+    opt = Adam(1e-3)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    reg = get_registry()
+    before = reg.counter("train_rollbacks_total").value
+    # 32 samples / batch 8 = 4 steps/epoch; epoch 1 commits ckpt-00000001,
+    # then two consecutive poisoned steps in epoch 2 (invocations 4,5 =
+    # steps 5,6) push the guard past rollback_after=2
+    plan = FaultPlan().arm("train.nonfinite_input", at=4, times=2)
+    with plan:
+        with pytest.warns(UserWarning, match="skipped"):
+            ts = trainer.fit(ts, _loader(), epochs=2)
+    assert reg.counter("train_rollbacks_total").value == before + 1
+    assert trainer.guard.total_skipped == 2
+    for leaf in jax.tree_util.tree_leaves(ts.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_trainer_guard_rejects_incompatible_modes():
+    cfg = TrainingConfig(nonfinite_policy="skip_step", steps_per_dispatch=4)
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        Trainer(_model("inc"), Adam(1e-3), "softmax_crossentropy",
+                config=cfg)
+    from dcnn_tpu.data.device_dataset import DeviceDataset
+    cfg2 = TrainingConfig(nonfinite_policy="skip_step", snapshot_dir=None)
+    tr = Trainer(_model("inc2"), Adam(1e-3), "softmax_crossentropy",
+                 config=cfg2)
+    rng = np.random.default_rng(0)
+    ds = DeviceDataset(rng.normal(size=(32, 1, 8, 8)).astype(np.float32),
+                       rng.integers(0, 4, 32), 4, batch_size=8)
+    ts = create_train_state(tr.model, tr.optimizer, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="resident"):
+        tr.train_epoch(ts, ds, jax.random.PRNGKey(0))
+
+
+# ==================================== end-to-end: kill + resume, bit-exact
+
+def _digits_loaders():
+    from dcnn_tpu.data import MNISTDataLoader
+    from dcnn_tpu.data.digits28 import ensure_digits28_csvs
+
+    d = ensure_digits28_csvs(REPO_ROOT)
+    train = MNISTDataLoader(os.path.join(d, "train.csv"),
+                            data_format="NCHW", batch_size=128, seed=0)
+    val = MNISTDataLoader(os.path.join(d, "test.csv"), data_format="NCHW",
+                          batch_size=256, shuffle=False, drop_last=False)
+    train.load_data()
+    val.load_data()
+    return train, val
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _digits_model(name):
+    return (SequentialBuilder(name)
+            .input((1, 28, 28))
+            .conv2d(4, 3, 1, 1).batchnorm().activation("relu")
+            .maxpool2d(2).flatten().dense(10)
+            .build())
+
+
+def _fit_run(name, tmpdir, epochs, resume="never", fault_plan=None):
+    cfg = TrainingConfig(learning_rate=1e-3, snapshot_dir=None,
+                        checkpoint_dir=tmpdir, checkpoint_every=1,
+                        resume=resume, progress_interval=0, seed=11)
+    model = _digits_model(name)
+    opt = Adam(1e-3)
+    trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
+    train, val = _digits_loaders()
+    if fault_plan is not None:
+        with fault_plan:
+            ts = trainer.fit(ts, train, val, epochs=epochs)
+    else:
+        ts = trainer.fit(ts, train, val, epochs=epochs)
+    return trainer, ts
+
+
+def test_kill_midepoch_resume_bit_exact_digits28(tmp_path):
+    """THE acceptance criterion: SIGKILL-style death mid-epoch, restart
+    with resume="auto", and the loss trajectory continues bit-exact
+    (float-equal per epoch) versus an uninterrupted reference run — on the
+    digits28 real-image fixture."""
+    ref_dir, crash_dir = str(tmp_path / "ref"), str(tmp_path / "crash")
+
+    ref_trainer, ref_ts = _fit_run("digits_ref", ref_dir, epochs=3)
+
+    # run 2: die mid-epoch-2 (a trip point armed as a CRASH — the process
+    # would be gone; nothing after the kill point runs). digits28 train =
+    # 1438 samples / batch 128 (drop_last) = 11 steps/epoch, so invocation
+    # 14 = step 15 = epoch 2, step 4: epoch 1's checkpoint is committed,
+    # epoch 2's never will be.
+    plan = FaultPlan().arm("train.nonfinite_input", at=14,
+                           exc=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        _fit_run("digits_kill", crash_dir, epochs=3, fault_plan=plan)
+    resumed, res_ts = _fit_run("digits_res", crash_dir, epochs=3,
+                               resume="auto")
+
+    ref_h = ref_trainer.history
+    res_h = resumed.history
+    assert [h["epoch"] for h in res_h] == [h["epoch"] for h in ref_h]
+    for hr, hc in zip(ref_h, res_h):
+        assert hr["train_loss"] == hc["train_loss"], (hr, hc)  # bit-exact
+        assert hr["val_acc"] == hc["val_acc"]
+    _assert_trees_equal(ref_ts.params, res_ts.params)
+    _assert_trees_equal(ref_ts.opt_state, res_ts.opt_state)
+
+
+def test_resume_auto_restores_lr_history_and_epoch(tmp_path):
+    """The cheap tier-1 twin of the slow digits28 test: synthetic data,
+    2+2 epochs, same bit-exactness contract plus lr-decay continuity."""
+    ref_dir, crash_dir = str(tmp_path / "ref"), str(tmp_path / "crash")
+
+    def run(name, d, epochs, resume="never", plan=None):
+        cfg = TrainingConfig(learning_rate=1e-2, lr_decay_factor=0.5,
+                            snapshot_dir=None, checkpoint_dir=d,
+                            checkpoint_every=1, resume=resume,
+                            progress_interval=0, seed=5)
+        model = _model(name)
+        opt = SGD(1e-2)
+        tr = Trainer(model, opt, "softmax_crossentropy", config=cfg)
+        ts = create_train_state(model, opt, jax.random.PRNGKey(cfg.seed))
+        if plan is not None:
+            with plan:
+                ts = tr.fit(ts, _loader(64, seed=2), epochs=epochs)
+        else:
+            ts = tr.fit(ts, _loader(64, seed=2), epochs=epochs)
+        return tr, ts
+
+    ref_tr, ref_ts = run("rs_ref", ref_dir, 4)
+    plan = FaultPlan().arm("train.nonfinite_input", at=13, exc=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        run("rs_kill", crash_dir, 4, plan=plan)
+    res_tr, res_ts = run("rs_res", crash_dir, 4, resume="auto")
+
+    assert len(res_tr.history) == len(ref_tr.history) == 4
+    for hr, hc in zip(ref_tr.history, res_tr.history):
+        assert hr["train_loss"] == hc["train_loss"]
+        assert hr["lr"] == hc["lr"]          # decay continued, not restarted
+    _assert_trees_equal(ref_ts.params, res_ts.params)
+    _assert_trees_equal(ref_ts.opt_state, res_ts.opt_state)
+    # resuming a finished run trains nothing: the restored history IS the
+    # full run and the epoch loop has no epochs left
+    res2, _ = run("rs_noop", crash_dir, 4, resume="auto")
+    assert [h["epoch"] for h in res2.history] == [1, 2, 3, 4]
+
+
+# ------------------------------------------------- example import smoke
+
+def test_resume_training_example_imports():
+    """Import smoke for examples/resume_training.py (same isolation dance
+    as the serve_snapshot/trace_training smokes: the examples dir must
+    resolve its own `common`)."""
+    import importlib
+    import sys
+
+    ex_dir = os.path.join(REPO_ROOT, "examples")
+    saved_common = sys.modules.pop("common", None)
+    sys.path.insert(0, ex_dir)
+    try:
+        mod = importlib.import_module("resume_training")
+        assert callable(mod.main)
+        assert callable(mod.run_training)
+        assert callable(mod.demo_kill_and_resume)
+    finally:
+        sys.path.remove(ex_dir)
+        sys.modules.pop("resume_training", None)
+        sys.modules.pop("common", None)
+        if saved_common is not None:
+            sys.modules["common"] = saved_common
+
+
+# ==================================================== streaming producer
+
+def test_streaming_producer_fault_surfaces_to_training_loop():
+    from dcnn_tpu.data.streaming import (
+        StreamingDeviceDataset, make_shard_step, train_streaming_epoch)
+
+    model = _model("stream")
+    opt = SGD(1e-2)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, size=(64, 1, 8, 8), dtype=np.uint8)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    ds = StreamingDeviceDataset(x, y, 4, batch_size=8, shard_batches=2)
+    step = make_shard_step(model, CE, opt, num_classes=4, batch_size=8,
+                           shard_batches=2)
+    with FaultPlan().arm("stream.produce", at=1):
+        with pytest.raises(InjectedFault, match="stream.produce"):
+            train_streaming_epoch(step, ts, ds, jax.random.PRNGKey(0),
+                                  lr=1e-2)
+    # and the next epoch (no plan) trains clean: nothing wedged. The failed
+    # epoch's shard-0 step consumed (donated) ts, so restart from a fresh
+    # state — exactly what a real restart does.
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+    ts2, loss = train_streaming_epoch(step, ts, ds, jax.random.PRNGKey(1),
+                                      lr=1e-2)
+    assert np.isfinite(loss)

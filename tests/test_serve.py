@@ -388,15 +388,66 @@ def test_batcher_user_cancel_while_queued(float_engine, tiny):
     b.drain()
 
 
-def test_batcher_shutdown_without_drain_cancels(float_engine, tiny):
+def test_batcher_shutdown_without_drain_fails_pending(float_engine, tiny):
+    """shutdown(drain=False) must FAIL still-pending futures with
+    ShutdownError — a caller blocked on result() is released with a clear
+    error, never orphaned on a forever-pending future."""
+    from dcnn_tpu.serve.batcher import ShutdownError
+
     *_, pool = tiny
     b = DynamicBatcher(float_engine, max_batch=4, start=False)
     futs = [b.submit(pool[i]) for i in range(3)]
     b.shutdown(drain=False)
-    assert all(f.cancelled() for f in futs)
+    for f in futs:
+        assert f.done() and not f.cancelled()
+        with pytest.raises(ShutdownError):
+            f.result(timeout=0)
     assert b.queue_depth == 0
     with pytest.raises(RuntimeError):
         b.submit(pool[0])
+
+
+def test_batcher_drain_timeout_fails_pending_not_orphans(float_engine, tiny):
+    """A drain(timeout=) that trips must release every still-pending
+    future with ShutdownError — including one held by a dispatch stuck in
+    a hung engine — then raise TimeoutError. No future is left
+    forever-pending, and the late engine completion is absorbed."""
+    import threading
+
+    from dcnn_tpu.serve.batcher import ShutdownError
+
+    *_, pool = tiny
+    b = DynamicBatcher(float_engine, max_batch=2, max_wait_ms=0,
+                       queue_capacity=8)
+    gate = threading.Event()
+    real_run = b.engine.run_padded
+
+    def hung_run(padded):
+        gate.wait(timeout=30)  # a wedged accelerator tunnel
+        return real_run(padded)
+
+    from types import SimpleNamespace
+    b.engine = SimpleNamespace(  # shadow only what submit/_run touch
+        run_padded=hung_run, pad_to_bucket=float_engine.pad_to_bucket,
+        input_shape=float_engine.input_shape, name=float_engine.name,
+        max_batch=float_engine.max_batch)
+
+    f0 = b.submit(pool[0])          # dispatched, stuck in hung_run
+    import time as _t
+    for _ in range(100):            # wait for the dispatcher to pick it up
+        if f0.running():
+            break
+        _t.sleep(0.01)
+    f1 = b.submit(pool[1])          # still queued behind the hung dispatch
+    with pytest.raises(TimeoutError):
+        b.drain(timeout=0.2)
+    for f in (f0, f1):
+        assert f.done()
+        with pytest.raises(ShutdownError):
+            f.result(timeout=0)
+    gate.set()                      # un-wedge: late set_result is absorbed
+    b._thread.join(timeout=30)
+    assert not b._thread.is_alive()
 
 
 # ---------------------------------------------------------------- metrics
