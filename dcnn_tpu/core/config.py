@@ -46,6 +46,12 @@ class TrainingConfig:
                                       # stage_batches=K) and run K train steps per
                                       # device dispatch (train.make_multi_step) —
                                       # the remote/tunnelled-TPU fast path
+    feed_workers: int = 0             # >0: parallel host input pipeline — a
+                                      # FeedWorkerPool of this many worker
+                                      # processes does gather/augment/collate
+                                      # into shared-memory slots for the
+                                      # prefetch/streaming feeds
+                                      # (data/workers.py; docs/performance.md)
 
     # -- fault tolerance (dcnn_tpu/resilience; docs/reliability.md) --
     checkpoint_dir: Optional[str] = None  # root for periodic atomic checkpoints
@@ -89,6 +95,7 @@ class TrainingConfig:
             scheduler_step=get_env("SCHEDULER_STEP", base.scheduler_step),
             steps_per_dispatch=get_env("STEPS_PER_DISPATCH",
                                        base.steps_per_dispatch),
+            feed_workers=get_env("FEED_WORKERS", base.feed_workers),
             checkpoint_dir=get_env("CKPT_DIR", base.checkpoint_dir or "") or None,
             checkpoint_every=get_env("CKPT_EVERY", base.checkpoint_every),
             checkpoint_keep=get_env("CKPT_KEEP", base.checkpoint_keep),

@@ -13,6 +13,10 @@ from .streaming import (
     StreamingDeviceDataset, make_shard_step, train_streaming_epoch,
 )
 from .transfer import TransferEngine, chunk_bounds, max_inflight
+from .workers import (
+    FeedWorkerPool, LocalSlots, PreparedShard, ShmSlots, prepare_shard,
+    serial_shards, shard_rng,
+)
 from .augment import (
     AugmentationBuilder, AugmentationStrategy,
     brightness, contrast, cutout, gaussian_noise, horizontal_flip,
@@ -33,6 +37,8 @@ __all__ = [
     "PrefetchLoader",
     "StreamingDeviceDataset", "make_shard_step", "train_streaming_epoch",
     "TransferEngine", "chunk_bounds", "max_inflight",
+    "FeedWorkerPool", "LocalSlots", "PreparedShard", "ShmSlots",
+    "prepare_shard", "serial_shards", "shard_rng",
     "AugmentationStrategy", "AugmentationBuilder",
     "brightness", "contrast", "cutout", "gaussian_noise", "horizontal_flip",
     "vertical_flip", "normalization", "random_crop", "rotation",

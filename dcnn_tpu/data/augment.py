@@ -11,6 +11,24 @@ Implemented as vectorized numpy batch transforms (applied host-side at batch
 assembly, like the reference's per-batch hook). Each op takes
 ``(batch NCHW/NHWC float32, np.random.Generator)`` and a probability of
 applying per-sample. Rotation uses scipy.ndimage.
+
+Two contracts every op honors (both load-bearing for the parallel input
+pipeline, ``data/workers.py``):
+
+- **Copy-on-write.** An op never mutates the caller's batch: it returns the
+  input unchanged when no sample is selected, and a fresh array otherwise.
+  (The r5 versions of cutout/flips/rotation/random_crop wrote into the
+  caller's array, corrupting the source dataset for any non-augmented
+  consumer sharing it.)
+- **Picklable.** Ops are module-level classes (the lowercase factory names
+  are aliases, so ``brightness(0.2, p=0.5)`` builds the same object it
+  always did), which lets an ``AugmentationStrategy`` ship to spawned
+  feed-worker processes.
+
+Determinism: an op consumes its ``rng`` in a fixed documented draw order, so
+the same generator state always produces the same batch — the property the
+worker pool's per-(epoch, shard) seeded generators turn into bit-identical
+parallel/serial feeds.
 """
 
 from __future__ import annotations
@@ -30,132 +48,212 @@ def _mask(rng: np.random.Generator, n: int, p: float) -> np.ndarray:
     return rng.random(n) < p
 
 
-def brightness(delta: float = 0.2, p: float = 0.5) -> BatchFn:
+class Brightness:
     """Additive brightness jitter in [-delta, delta]."""
-    def fn(x, rng):
-        m = _mask(rng, len(x), p)
-        shifts = rng.uniform(-delta, delta, size=(len(x),)).astype(np.float32)
+
+    def __init__(self, delta: float = 0.2, p: float = 0.5):
+        self.delta = float(delta)
+        self.p = float(p)
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        m = _mask(rng, len(x), self.p)
+        shifts = rng.uniform(-self.delta, self.delta,
+                             size=(len(x),)).astype(np.float32)
         shifts = np.where(m, shifts, 0.0)
         return x + shifts.reshape(-1, *([1] * (x.ndim - 1)))
-    return fn
 
 
-def contrast(lower: float = 0.8, upper: float = 1.2, p: float = 0.5,
-             data_format: str = "NCHW") -> BatchFn:
+class Contrast:
     """Scale around the per-image mean by a factor in [lower, upper]."""
-    def fn(x, rng):
-        m = _mask(rng, len(x), p)
-        factors = rng.uniform(lower, upper, size=(len(x),)).astype(np.float32)
+
+    def __init__(self, lower: float = 0.8, upper: float = 1.2, p: float = 0.5,
+                 data_format: str = "NCHW"):
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self.p = float(p)
+        self.data_format = data_format
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        m = _mask(rng, len(x), self.p)
+        factors = rng.uniform(self.lower, self.upper,
+                              size=(len(x),)).astype(np.float32)
         factors = np.where(m, factors, 1.0).reshape(-1, *([1] * (x.ndim - 1)))
         mean = x.mean(axis=tuple(range(1, x.ndim)), keepdims=True)
         return (x - mean) * factors + mean
-    return fn
 
 
-def cutout(size: int = 8, p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
-    """Zero a random size×size square per image (reference Cutout)."""
-    ha, wa = _hw_axes(data_format)
+class Cutout:
+    """Zero a random size×size square per image (reference Cutout).
 
-    def fn(x, rng):
+    Draw order: per image, one ``rng.random()`` gate, then (only when the
+    gate passes) two ``rng.integers`` center draws."""
+
+    def __init__(self, size: int = 8, p: float = 0.5,
+                 data_format: str = "NCHW"):
+        self.size = int(size)
+        self.p = float(p)
+        self.data_format = data_format
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        ha, wa = _hw_axes(self.data_format)
         h, w = x.shape[ha], x.shape[wa]
+        out = None  # copy-on-write: the caller's batch is never mutated
         for i in range(len(x)):
-            if rng.random() >= p:
+            if rng.random() >= self.p:
                 continue
+            if out is None:
+                out = x.copy()
             cy, cx = rng.integers(0, h), rng.integers(0, w)
-            y0, y1 = max(0, cy - size // 2), min(h, cy + size // 2)
-            x0, x1 = max(0, cx - size // 2), min(w, cx + size // 2)
-            if data_format == "NCHW":
-                x[i, :, y0:y1, x0:x1] = 0.0
+            y0, y1 = max(0, cy - self.size // 2), min(h, cy + self.size // 2)
+            x0, x1 = max(0, cx - self.size // 2), min(w, cx + self.size // 2)
+            if self.data_format == "NCHW":
+                out[i, :, y0:y1, x0:x1] = 0.0
             else:
-                x[i, y0:y1, x0:x1, :] = 0.0
-        return x
-    return fn
+                out[i, y0:y1, x0:x1, :] = 0.0
+        return x if out is None else out
 
 
-def gaussian_noise(std: float = 0.05, p: float = 0.5) -> BatchFn:
-    def fn(x, rng):
-        m = _mask(rng, len(x), p).reshape(-1, *([1] * (x.ndim - 1)))
-        noise = rng.normal(0.0, std, size=x.shape).astype(np.float32)
+class GaussianNoise:
+    def __init__(self, std: float = 0.05, p: float = 0.5):
+        self.std = float(std)
+        self.p = float(p)
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        m = _mask(rng, len(x), self.p).reshape(-1, *([1] * (x.ndim - 1)))
+        noise = rng.normal(0.0, self.std, size=x.shape).astype(np.float32)
         return x + np.where(m, noise, 0.0)
-    return fn
 
 
-def horizontal_flip(p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
-    _, wa = _hw_axes(data_format)
+class HorizontalFlip:
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        self.p = float(p)
+        self.data_format = data_format
 
-    def fn(x, rng):
-        m = _mask(rng, len(x), p)
-        x[m] = np.flip(x[m], axis=wa)
-        return x
-    return fn
-
-
-def vertical_flip(p: float = 0.5, data_format: str = "NCHW") -> BatchFn:
-    ha, _ = _hw_axes(data_format)
-
-    def fn(x, rng):
-        m = _mask(rng, len(x), p)
-        x[m] = np.flip(x[m], axis=ha)
-        return x
-    return fn
-
-
-def normalization(mean: Sequence[float], std: Sequence[float],
-                  data_format: str = "NCHW") -> BatchFn:
-    """Per-channel (x-mean)/std (reference Normalization — always applied)."""
-    mean_a = np.asarray(mean, np.float32)
-    std_a = np.asarray(std, np.float32)
-
-    def fn(x, rng):
-        if data_format == "NCHW":
-            return (x - mean_a.reshape(1, -1, 1, 1)) / std_a.reshape(1, -1, 1, 1)
-        return (x - mean_a) / std_a
-    return fn
-
-
-def random_crop(padding: int = 4, p: float = 1.0, data_format: str = "NCHW") -> BatchFn:
-    """Pad by ``padding`` (reflect zeros) then crop back at a random offset."""
-    ha, wa = _hw_axes(data_format)
-
-    def fn(x, rng):
-        h, w = x.shape[ha], x.shape[wa]
-        pad_spec = [(0, 0)] * x.ndim
-        pad_spec[ha] = (padding, padding)
-        pad_spec[wa] = (padding, padding)
-        padded = np.pad(x, pad_spec)
-        out = x
-        for i in range(len(x)):
-            if rng.random() >= p:
-                continue
-            oy = rng.integers(0, 2 * padding + 1)
-            ox = rng.integers(0, 2 * padding + 1)
-            if data_format == "NCHW":
-                out[i] = padded[i, :, oy:oy + h, ox:ox + w]
-            else:
-                out[i] = padded[i, oy:oy + h, ox:ox + w, :]
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        _, wa = _hw_axes(self.data_format)
+        m = _mask(rng, len(x), self.p)
+        if not m.any():
+            return x
+        out = x.copy()
+        out[m] = np.flip(x[m], axis=wa)
         return out
-    return fn
 
 
-def rotation(max_degrees: float = 15.0, p: float = 0.5,
-             data_format: str = "NCHW") -> BatchFn:
-    from scipy import ndimage
-    ha, wa = _hw_axes(data_format)
+class VerticalFlip:
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        self.p = float(p)
+        self.data_format = data_format
 
-    def fn(x, rng):
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        ha, _ = _hw_axes(self.data_format)
+        m = _mask(rng, len(x), self.p)
+        if not m.any():
+            return x
+        out = x.copy()
+        out[m] = np.flip(x[m], axis=ha)
+        return out
+
+
+class Normalization:
+    """Per-channel (x-mean)/std (reference Normalization — always applied)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float],
+                 data_format: str = "NCHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        if self.data_format == "NCHW":
+            return ((x - self.mean.reshape(1, -1, 1, 1))
+                    / self.std.reshape(1, -1, 1, 1))
+        return (x - self.mean) / self.std
+
+
+class RandomCrop:
+    """Pad by ``padding`` (zeros) then crop back at a random offset.
+
+    Vectorized: ONE batched draw for the apply mask and one per offset axis
+    (``rng.random(n)``, ``rng.integers(n)``, ``rng.integers(n)``), then a
+    single batched window gather via ``sliding_window_view`` — no per-image
+    Python loop. (The r5 version drew per image inside a loop, so crop
+    values differ from r5 for the same generator state; the distribution is
+    identical.)"""
+
+    def __init__(self, padding: int = 4, p: float = 1.0,
+                 data_format: str = "NCHW"):
+        self.padding = int(padding)
+        self.p = float(p)
+        self.data_format = data_format
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        ha, wa = _hw_axes(self.data_format)
+        h, w = x.shape[ha], x.shape[wa]
+        n = len(x)
+        pad = self.padding
+        m = _mask(rng, n, self.p)
+        oy = rng.integers(0, 2 * pad + 1, size=n)
+        ox = rng.integers(0, 2 * pad + 1, size=n)
+        if not m.any():
+            return x
+        pad_spec = [(0, 0)] * x.ndim
+        pad_spec[ha] = (pad, pad)
+        pad_spec[wa] = (pad, pad)
+        padded = np.pad(x, pad_spec)
+        # every h×w window of every image, as views: indexing one window per
+        # image with the batched offsets is the whole "loop"
+        win = np.lib.stride_tricks.sliding_window_view(
+            padded, (h, w), axis=(ha, wa))
+        idx = np.arange(n)
+        if self.data_format == "NCHW":
+            crops = win[idx, :, oy, ox]              # -> (n, C, h, w)
+        else:
+            crops = win[idx, oy, ox]                 # -> (n, C, h, w)
+            crops = np.ascontiguousarray(
+                np.moveaxis(crops, 1, -1))           # -> (n, h, w, C)
+        out = x.copy()
+        out[m] = crops[m]
+        return out
+
+
+class Rotation:
+    def __init__(self, max_degrees: float = 15.0, p: float = 0.5,
+                 data_format: str = "NCHW"):
+        self.max_degrees = float(max_degrees)
+        self.p = float(p)
+        self.data_format = data_format
+
+    def __call__(self, x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        from scipy import ndimage
+        ha, wa = _hw_axes(self.data_format)
+        out = None  # copy-on-write, like Cutout
         for i in range(len(x)):
-            if rng.random() >= p:
+            if rng.random() >= self.p:
                 continue
-            deg = float(rng.uniform(-max_degrees, max_degrees))
-            x[i] = ndimage.rotate(x[i], deg, axes=(ha - 1, wa - 1),
-                                  reshape=False, order=1, mode="nearest")
-        return x
-    return fn
+            if out is None:
+                out = x.copy()
+            deg = float(rng.uniform(-self.max_degrees, self.max_degrees))
+            out[i] = ndimage.rotate(x[i], deg, axes=(ha - 1, wa - 1),
+                                    reshape=False, order=1, mode="nearest")
+        return x if out is None else out
+
+
+# Factory aliases: the historical lowercase constructors. ``brightness(0.2,
+# p=0.5)`` returns a Brightness instance — same call sites, now picklable.
+brightness = Brightness
+contrast = Contrast
+cutout = Cutout
+gaussian_noise = GaussianNoise
+horizontal_flip = HorizontalFlip
+vertical_flip = VerticalFlip
+normalization = Normalization
+random_crop = RandomCrop
+rotation = Rotation
 
 
 class AugmentationStrategy:
     """Ordered augmentation pipeline (reference ``AugmentationStrategy``,
-    augmentation.hpp:51)."""
+    augmentation.hpp:51). Picklable when its ops are (all built-ins are)."""
 
     def __init__(self, ops: Optional[List[BatchFn]] = None):
         self.ops: List[BatchFn] = list(ops or [])
@@ -179,39 +277,39 @@ class AugmentationBuilder:
         self.data_format = data_format
 
     def brightness(self, delta: float = 0.2, p: float = 0.5):
-        self._strategy.add(brightness(delta, p))
+        self._strategy.add(Brightness(delta, p))
         return self
 
     def contrast(self, lower: float = 0.8, upper: float = 1.2, p: float = 0.5):
-        self._strategy.add(contrast(lower, upper, p, self.data_format))
+        self._strategy.add(Contrast(lower, upper, p, self.data_format))
         return self
 
     def cutout(self, size: int = 8, p: float = 0.5):
-        self._strategy.add(cutout(size, p, self.data_format))
+        self._strategy.add(Cutout(size, p, self.data_format))
         return self
 
     def gaussian_noise(self, std: float = 0.05, p: float = 0.5):
-        self._strategy.add(gaussian_noise(std, p))
+        self._strategy.add(GaussianNoise(std, p))
         return self
 
     def horizontal_flip(self, p: float = 0.5):
-        self._strategy.add(horizontal_flip(p, self.data_format))
+        self._strategy.add(HorizontalFlip(p, self.data_format))
         return self
 
     def vertical_flip(self, p: float = 0.5):
-        self._strategy.add(vertical_flip(p, self.data_format))
+        self._strategy.add(VerticalFlip(p, self.data_format))
         return self
 
     def normalization(self, mean: Sequence[float], std: Sequence[float]):
-        self._strategy.add(normalization(mean, std, self.data_format))
+        self._strategy.add(Normalization(mean, std, self.data_format))
         return self
 
     def random_crop(self, padding: int = 4, p: float = 1.0):
-        self._strategy.add(random_crop(padding, p, self.data_format))
+        self._strategy.add(RandomCrop(padding, p, self.data_format))
         return self
 
     def rotation(self, max_degrees: float = 15.0, p: float = 0.5):
-        self._strategy.add(rotation(max_degrees, p, self.data_format))
+        self._strategy.add(Rotation(max_degrees, p, self.data_format))
         return self
 
     def build(self) -> AugmentationStrategy:

@@ -69,14 +69,32 @@ class BaseDataLoader:
         ``prepare_batches``-with-shuffle semantics)."""
         self._epoch = epoch
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def epoch_rng(self) -> np.random.Generator:
+        """The epoch's generator: the shuffle permutation draws first,
+        then (in ``__iter__``) the augmentation hook continues the same
+        stream — one seed fully determines an epoch."""
+        return np.random.default_rng(self.seed + self._epoch)
+
+    def batch_indices(self, rng: Optional[np.random.Generator] = None
+                      ) -> Iterator[np.ndarray]:
+        """Per-batch row-index arrays for the current epoch, in iteration
+        order — THE definition of batch membership/order, consumed by both
+        ``__iter__`` and the parallel feed's task planner
+        (``PrefetchLoader(feed_workers=...)``), so the two can never
+        drift. ``rng`` lets ``__iter__`` pass its own generator (the
+        augmentation hook continues that stream after the permutation)."""
         self._ensure_loaded()
         n = len(self._x)
-        rng = np.random.default_rng(self.seed + self._epoch)
+        if rng is None:
+            rng = self.epoch_rng()
         idx = rng.permutation(n) if self._shuffle else np.arange(n)
         stop = n - n % self.batch_size if self.drop_last else n
         for start in range(0, stop, self.batch_size):
-            take = idx[start:start + self.batch_size]
+            yield idx[start:start + self.batch_size]
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        rng = self.epoch_rng()
+        for take in self.batch_indices(rng):
             xb = self._x[take]
             yb = self._y[take]
             if self.augmentation is not None:

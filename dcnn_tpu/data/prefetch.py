@@ -30,6 +30,8 @@ import queue
 import threading
 from typing import Any, Callable, Iterator, Optional, Tuple
 
+import numpy as np
+
 import jax
 
 _SENTINEL = object()
@@ -61,6 +63,20 @@ class PrefetchLoader:
     blocking put — and reassembles on device with a jitted concatenate, so
     the yielded arrays are bit-identical to the plain path. Ignored when a
     ``sharding`` is set (sharded placement stays one ``device_put``).
+    ``feed_workers=N`` delegates the whole host side of the producer —
+    row gather, optional ``worker_augment`` (a picklable
+    ``AugmentationStrategy`` applied in float32 with per-(epoch, chunk)
+    seeded rng), and collation into the staged [K, B, ...] layout — to a
+    :class:`~dcnn_tpu.data.workers.FeedWorkerPool` of N worker processes
+    producing into shared-memory ring slots; without ``worker_augment``
+    the yielded batches are bit-identical to the serial producer. Requires
+    a ``BaseDataLoader``-style inner (in-memory ``_x``/``_y`` arrays) with
+    no entangled ``augmentation`` hook (its single sequential rng cannot
+    be parallelized — move the recipe to ``worker_augment``); ``transform``
+    is likewise producer-serial-only and mutually exclusive with the pool.
+    ``worker_pool`` injects a caller-owned (possibly thread-backend) pool;
+    ``close()`` releases an internally-created one (also invoked by
+    ``with PrefetchLoader(...) as pf:``).
     """
 
     def __init__(self, inner, depth: int = 2,
@@ -68,11 +84,16 @@ class PrefetchLoader:
                  transform: Optional[Callable] = None,
                  device_transform: Optional[Callable] = None,
                  stage_batches: int = 1,
-                 transfer_engine: Optional[Any] = None):
+                 transfer_engine: Optional[Any] = None,
+                 feed_workers: int = 0,
+                 worker_augment: Optional[Callable] = None,
+                 worker_pool: Optional[Any] = None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         if stage_batches < 1:
             raise ValueError("stage_batches must be >= 1")
+        if feed_workers < 0:
+            raise ValueError("feed_workers must be >= 0")
         self.inner = inner
         self.depth = depth
         self.sharding = sharding
@@ -80,6 +101,15 @@ class PrefetchLoader:
         self.device_transform = device_transform
         self.stage_batches = stage_batches
         self.transfer_engine = transfer_engine
+        self.feed_workers = feed_workers
+        self.worker_augment = worker_augment
+        self._pool = worker_pool
+        self._own_pool = False
+        if self._pooled and transform is not None:
+            raise ValueError(
+                "transform= runs on the serial producer thread and cannot "
+                "compose with the worker pool — express it as a picklable "
+                "worker_augment (AugmentationStrategy) instead")
 
     # passthroughs so PrefetchLoader is a drop-in for Trainer.fit
     @property
@@ -96,6 +126,125 @@ class PrefetchLoader:
     def shuffle(self, epoch: int) -> None:
         if hasattr(self.inner, "shuffle"):
             self.inner.shuffle(epoch)
+
+    # -- worker-pool delegation -------------------------------------------
+    @property
+    def _pooled(self) -> bool:
+        return self.feed_workers > 0 or self._pool is not None
+
+    def close(self) -> None:
+        """Release an internally-created worker pool (workers + shared
+        memory). Idempotent; a caller-provided ``worker_pool`` is the
+        caller's to close."""
+        if self._own_pool and self._pool is not None:
+            self._pool.close()
+            self._pool = None
+            self._own_pool = False
+
+    def __enter__(self) -> "PrefetchLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _ensure_pool(self):
+        if self._pool is not None:
+            return self._pool
+        from .workers import FeedWorkerPool
+
+        inner = self.inner
+        if hasattr(inner, "_ensure_loaded"):
+            inner._ensure_loaded()
+        x = getattr(inner, "_x", None)
+        y = getattr(inner, "_y", None)
+        if x is None or y is None:
+            raise ValueError(
+                "feed_workers= needs a BaseDataLoader-style inner with "
+                "in-memory arrays (the pool gathers rows itself); got "
+                f"{type(inner).__name__}")
+        self._pool = FeedWorkerPool(
+            x, y, self.stage_batches * inner.batch_size,
+            num_workers=self.feed_workers, augment=self.worker_augment,
+            seed=getattr(inner, "seed", 0))
+        self._own_pool = True
+        return self._pool
+
+    def _pool_plan(self):
+        """Group the inner loader's batch plan (its own
+        ``batch_indices()`` — the ONE definition of batch order, shared
+        with ``__iter__``) into pool tasks that mirror the staged-chunk
+        boundaries: full batches in groups of ``stage_batches``, a ragged
+        tail batch on its own — so the pooled epoch yields the same chunk
+        shapes and contents as the serial producer."""
+        inner = self.inner
+        if getattr(inner, "augmentation", None) is not None:
+            raise ValueError(
+                "the inner loader's augmentation hook draws from one "
+                "sequential rng and cannot be parallelized bit-stably; "
+                "move the recipe to worker_augment=")
+        if not hasattr(inner, "batch_indices"):
+            raise ValueError(
+                "feed_workers= needs a BaseDataLoader-style inner exposing "
+                "batch_indices() (the shared batch-order plan); got "
+                f"{type(inner).__name__}")
+        b = inner.batch_size
+        sels, group = [], []
+        for take in inner.batch_indices():
+            if len(take) < b:       # ragged tail: its own chunk
+                if group:
+                    sels.append(np.concatenate(group))
+                    group = []
+                sels.append(np.asarray(take, np.int64))
+                continue
+            group.append(np.asarray(take, np.int64))
+            if len(group) == self.stage_batches:
+                sels.append(np.concatenate(group))
+                group = []
+        if group:
+            sels.append(np.concatenate(group))
+        return sels
+
+    def _produce_pooled(self, q: queue.Queue, stop: threading.Event,
+                        err: list) -> None:
+        from .workers import put_may_alias
+
+        try:
+            pool = self._ensure_pool()
+            epoch = int(getattr(self.inner, "_epoch", 0))
+            b = self.inner.batch_size
+            it = pool.shards(self._pool_plan(), epoch=epoch)
+            try:
+                for ps in it:
+                    if stop.is_set():
+                        return
+                    xh, yh = ps.for_put()
+                    if self.stage_batches > 1:
+                        # collated view -> the staged [K, B, ...] layout
+                        # (a reshape of the slot — no copy); a ragged tail
+                        # ships as its own [1, B', ...] chunk
+                        k = max(ps.rows // b, 1) if ps.rows % b == 0 else 1
+                        xh = xh.reshape(k, ps.rows // k, *xh.shape[1:])
+                        yh = yh.reshape(k, ps.rows // k, *yh.shape[1:])
+                    dev = self._device_put(xh, yh)
+                    if ps.leased and not put_may_alias():
+                        # the put copies from the recyclable slot (real
+                        # H2D): make it durable before recycling. (On
+                        # aliasing backends for_put() already detached.)
+                        jax.block_until_ready(dev)
+                    ps.release()
+                    q.put(dev)
+            finally:
+                it.close()
+        except BaseException as e:  # noqa: BLE001 - repropagated by caller
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
 
     def _device_put(self, x, y):
         if self.sharding is not None:
@@ -164,6 +313,8 @@ class PrefetchLoader:
             finally:
                 q.put(_SENTINEL)
 
+        if self._pooled:
+            produce = lambda: self._produce_pooled(q, stop, err)  # noqa: E731
         t = threading.Thread(target=produce, name="prefetch-producer",
                              daemon=True)
         t.start()

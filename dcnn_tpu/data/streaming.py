@@ -42,6 +42,7 @@ from .. import native
 from ..obs import get_tracer
 from ..resilience import faults as _faults
 from .transfer import TransferEngine
+from .workers import FeedWorkerPool
 
 
 def make_shard_step(model, loss_fn: Callable, optimizer, *, num_classes: int,
@@ -95,10 +96,18 @@ class StreamingDeviceDataset:
     remainder that doesn't fill a shard is folded into the epoch by
     re-sampling shard boundaries each epoch (host-side shard permutation →
     different samples are dropped each epoch, matching drop_last loader
-    semantics shard-wise)."""
+    semantics shard-wise).
+
+    ``workers``/``host_augment`` are the default knobs for the parallel
+    host input pipeline (``data/workers.py``): epochs driven through
+    :func:`train_streaming_epoch` then gather/augment/pack each shard on a
+    ``workers``-process pool instead of the single producer thread.
+    ``workers=0`` with a ``host_augment`` runs the same deterministic
+    prepare serially (the bit-identity reference)."""
 
     def __init__(self, x: np.ndarray, y: np.ndarray, num_classes: int, *,
-                 batch_size: int, shard_batches: int = 8, seed: int = 0):
+                 batch_size: int, shard_batches: int = 8, seed: int = 0,
+                 workers: int = 0, host_augment=None):
         x = np.ascontiguousarray(x)
         y = np.asarray(y)
         if y.ndim == 2:
@@ -115,6 +124,9 @@ class StreamingDeviceDataset:
                 f"dataset ({len(x)}) smaller than one shard "
                 f"({self.shard_samples}) — use DeviceDataset (resident) instead")
         self.num_shards = len(x) // self.shard_samples
+        self.seed = int(seed)
+        self.workers = int(workers)
+        self.host_augment = host_augment
         self._rng = np.random.default_rng(seed)
 
     @property
@@ -147,7 +159,11 @@ class StreamingDeviceDataset:
 def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                           lr: float, *,
                           timeline: Optional[List[dict]] = None,
-                          engine: Optional[TransferEngine] = None):
+                          engine: Optional[TransferEngine] = None,
+                          workers: Optional[int] = None,
+                          host_augment=None,
+                          worker_pool: Optional[FeedWorkerPool] = None,
+                          epoch: int = 0):
     """One epoch with a producer thread feeding a bounded queue: the host
     side of the feed runs on its own thread(s), so it overlaps the device
     compute the consumer loop dispatches.
@@ -173,6 +189,21 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
     reproduces the r5 monolithic path exactly (the bit-identity reference
     in tests/test_transfer.py).
 
+    ``workers`` routes the host side of the feed — gather, optional
+    ``host_augment`` (an :class:`~dcnn_tpu.data.augment.AugmentationStrategy`
+    run in float32, re-quantized to the uint8 wire), label prep, packing —
+    through a :class:`~dcnn_tpu.data.workers.FeedWorkerPool` of that many
+    worker processes writing preallocated shared-memory ring slots; the
+    producer thread hands filled slots straight to the transfer engine.
+    Default: the dataset's ``workers`` attribute (0 = the in-line serial
+    path). Output batches are bit-identical for every worker count
+    (per-(epoch, shard) seeded augmentation + ordered delivery).
+    ``worker_pool`` passes a caller-owned pool (reused across epochs —
+    workers and slots are start-once costs); otherwise a private pool is
+    built and closed per call when ``workers > 0``. ``epoch`` seeds the
+    per-shard augmentation rng derivation (pass the real epoch index for
+    fresh augmentation draws each epoch).
+
     ``timeline``: pass a list to receive one dict per shard —
     ``{shard, gather_s, put_s, feed_wall_s, queue_wait_s, dispatch_s,
     put_done_t, dispatch_t, chunks, inflight_max, h2d_gbps, bytes}``.
@@ -185,10 +216,44 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
 
     Returns (ts, mean_loss)."""
     t_epoch0 = time.perf_counter()
+    if workers is None:
+        workers = getattr(dataset, "workers", 0)
+    if host_augment is None:
+        host_augment = getattr(dataset, "host_augment", None)
+    use_pool = worker_pool is not None or workers > 0 \
+        or host_augment is not None
+    # validate BEFORE creating any owned resource, so an early raise
+    # can't leak a transfer-thread pool or worker processes
+    if worker_pool is not None:
+        if worker_pool.max_rows < dataset.shard_samples:
+            raise ValueError(f"worker pool slots hold "
+                             f"{worker_pool.max_rows} rows; the dataset's "
+                             f"shards need {dataset.shard_samples}")
+        pooled_workers = worker_pool.num_workers
+    else:
+        pooled_workers = workers
+    if use_pool and pooled_workers > 0 and engine is not None \
+            and not engine.fence:
+        # a recycled slot must never be re-written while its bytes are
+        # still on the wire; the fenced engine is what makes release safe
+        raise ValueError("worker-pool feed requires a fenced "
+                         "TransferEngine (fence=True)")
     own_engine = engine is None
     if own_engine:
         engine = TransferEngine(num_chunks=4, num_threads=2,
                                 reassemble="chunks")
+    own_pool = worker_pool is None and use_pool
+    pool = worker_pool
+    if own_pool:
+        try:
+            pool = FeedWorkerPool(dataset.x, dataset.y,
+                                  dataset.shard_samples,
+                                  num_workers=workers, augment=host_augment,
+                                  seed=getattr(dataset, "seed", 0))
+        except BaseException:
+            if own_engine:
+                engine.close()
+            raise
     q: "queue.Queue" = queue.Queue(maxsize=1)
     stop = threading.Event()
 
@@ -215,6 +280,60 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
             for sx, sy in dataset.shards():
                 yield sx, sy, None
 
+    def produce_pooled():
+        # worker-pool feed: the pool's workers gather/augment/pack each
+        # shard into shared-memory slots; this thread only ships filled
+        # slots (fenced — see the engine check above) and recycles them
+        it = pool.shards(dataset.shard_selections(), epoch=epoch)
+        try:
+            for i, ps in enumerate(it):
+                if stop.is_set():
+                    return
+                _faults.trip("stream.produce", shard=i)
+                sx_h, sy_h = ps.for_put()
+                sx, sy, stats = engine.put_shard(sx_h, sy_h, None,
+                                                 t_base=t_epoch0)
+                prep = ps.stats
+                ps.release()  # bytes are on device (fenced) — recycle
+                stats = dict(stats)
+                stats["prep"] = {
+                    "worker": prep.get("worker"),
+                    "gather_s": prep["gather_s"],
+                    "augment_s": prep["augment_s"],
+                    "pack_s": prep["pack_s"],
+                    "prep_s": prep["prep_s"],
+                    "prep_t0": prep["gather_t0"] - t_epoch0,
+                    "prep_t1": prep["pack_t1"] - t_epoch0,
+                }
+                if not put_or_stop(
+                        (i, sx, sy, stats, time.perf_counter() - t_epoch0)):
+                    return
+        finally:
+            it.close()  # reclaims in-flight slots if we bailed early
+
+    def produce_serial():
+        it = shard_plan()
+        i = 0
+        while not stop.is_set():
+            nxt = next(it, None)
+            if nxt is None:
+                break
+            # fault-injection point: an armed "stream.produce" raises
+            # here at shard at=i, proving the sentinel path delivers
+            # producer-thread failures to the training loop
+            _faults.trip("stream.produce", shard=i)
+            # per-chunk fencing happens on the engine's pool threads
+            # (device_put is async-ISSUE on the tunnelled backend —
+            # without the fence the queue would pace on issue time and
+            # the spans would not measure the transfer); the consumer's
+            # dispatches still overlap the whole shipment.
+            sx, sy, stats = engine.put_shard(nxt[0], nxt[1], nxt[2],
+                                             t_base=t_epoch0)
+            if not put_or_stop(
+                    (i, sx, sy, stats, time.perf_counter() - t_epoch0)):
+                return
+            i += 1
+
     def producer():
         # the terminating sentinel is (None | exception): a producer-side
         # failure (device_put OOM, tunnel error, a raising chunk task) must
@@ -222,27 +341,10 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
         # missing sentinel that would park q.get() forever
         err = None
         try:
-            it = shard_plan()
-            i = 0
-            while not stop.is_set():
-                nxt = next(it, None)
-                if nxt is None:
-                    break
-                # fault-injection point: an armed "stream.produce" raises
-                # here at shard at=i, proving the sentinel path delivers
-                # producer-thread failures to the training loop
-                _faults.trip("stream.produce", shard=i)
-                # per-chunk fencing happens on the engine's pool threads
-                # (device_put is async-ISSUE on the tunnelled backend —
-                # without the fence the queue would pace on issue time and
-                # the spans would not measure the transfer); the consumer's
-                # dispatches still overlap the whole shipment.
-                sx, sy, stats = engine.put_shard(nxt[0], nxt[1], nxt[2],
-                                                t_base=t_epoch0)
-                if not put_or_stop(
-                        (i, sx, sy, stats, time.perf_counter() - t_epoch0)):
-                    return
-                i += 1
+            if pool is not None:
+                produce_pooled()
+            else:
+                produce_serial()
         except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
             err = e
         put_or_stop(err)
@@ -270,7 +372,7 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
             t5 = time.perf_counter()
             losses.append(loss)
             if timeline is not None:
-                timeline.append({
+                entry = {
                     "shard": i, "gather_s": stats["gather_s"],
                     "put_s": stats["put_s"],
                     "feed_wall_s": stats["wall_s"],
@@ -280,12 +382,17 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                     "chunks": stats["chunks"],
                     "inflight_max": stats["inflight_max"],
                     "h2d_gbps": stats["h2d_gbps"],
-                    "bytes": stats["bytes"]})
+                    "bytes": stats["bytes"]}
+                if "prep" in stats:
+                    entry["prep"] = stats["prep"]
+                timeline.append(entry)
     finally:
         stop.set()
         worker.join(timeout=60.0)
         if own_engine:
             engine.close()
+        if own_pool:
+            pool.close()
     # ONE on-device reduction + ONE readback: per-loss float() readbacks
     # measured ~3 s EACH on the tunnelled backend (13.6 s vs 0.41 s for a
     # 4-shard epoch) and were the r4 "overlap stalls at 0.40" culprit

@@ -87,12 +87,13 @@ def max_inflight(spans: Sequence[dict]) -> int:
     return peak
 
 
-def _union_seconds(spans: Sequence[dict]) -> float:
-    """Total wall covered by the union of the put intervals (overlapping
-    transfers must not double-count toward the effective-bandwidth wall)."""
-    ivs = sorted((s["put_start_t"], s["put_end_t"]) for s in spans)
+def union_seconds(intervals: Sequence[Tuple[float, float]]) -> float:
+    """Total wall covered by the union of ``(lo, hi)`` intervals —
+    overlapping spans must not double-count toward an effective-rate wall.
+    Shared by the transfer engine's put accounting and bench.py's
+    worker-prep accounting."""
     total, cur_lo, cur_hi = 0.0, None, None
-    for lo, hi in ivs:
+    for lo, hi in sorted(intervals):
         if cur_hi is None or lo > cur_hi:
             if cur_hi is not None:
                 total += cur_hi - cur_lo
@@ -252,7 +253,8 @@ class TransferEngine:
     @staticmethod
     def _stats(spans: List[dict], peak: int, wall_s: float) -> dict:
         total_bytes = sum(s["bytes"] for s in spans)
-        put_union = _union_seconds(spans)
+        put_union = union_seconds(
+            [(s["put_start_t"], s["put_end_t"]) for s in spans])
         return {
             "chunks": spans,
             "gather_s": sum(s["gather_s"] for s in spans),

@@ -80,6 +80,10 @@ def _null_end(handle, **attrs):
     return None
 
 
+def _null_record_span(name, t0_s, t1_s, *, track=None, **attrs):
+    return None
+
+
 class _Span:
     """Live span: context-manager for same-thread use, explicit handle for
     cross-thread ``begin``/``end``. ``track`` pins the display row; default
@@ -144,11 +148,13 @@ class Tracer:
             self.begin = self._span  # same stamped handle, no CM entry needed
             self.end = self._end
             self.instant = self._instant
+            self.record_span = self._record_span
         else:
             self.span = _null_span
             self.begin = _null_span
             self.end = _null_end
             self.instant = _null_span
+            self.record_span = _null_record_span
 
     # -- recording (real implementations) ----------------------------------
     def _span(self, name: str, *, track: Optional[str] = None,
@@ -164,6 +170,20 @@ class Tracer:
         if attrs:
             handle.attrs.update(attrs)
         self._record(handle)
+
+    def _record_span(self, name: str, t0_s: float, t1_s: float, *,
+                     track: Optional[str] = None, **attrs) -> None:
+        """Record an already-measured ``[t0_s, t1_s)`` interval (timestamps
+        in this tracer's clock domain — ``time.perf_counter`` for the global
+        instance). The replay entry point for intervals measured where the
+        tracer can't run: feed-worker processes time their gather/augment/
+        pack phases with ``perf_counter`` (CLOCK_MONOTONIC — one clock
+        system-wide on Linux, so child stamps land on the parent timeline)
+        and the parent replays them onto per-worker tracks."""
+        self._events.append(
+            (name, t0_s - self._epoch, max(t1_s - t0_s, 0.0),
+             track if track is not None else threading.current_thread().name,
+             attrs))
 
     def _instant(self, name: str, *, track: Optional[str] = None, **attrs):
         t = self._clock()

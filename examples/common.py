@@ -26,11 +26,15 @@ def setup(name: str) -> TrainingConfig:
 def with_prefetch(loader, cfg):
     """Wrap the train loader in the prefetching input pipeline: background
     batch prep + H2D overlap, and — when cfg.steps_per_dispatch > 1 — K-batch
-    chunked staging feeding the Trainer's multi-step fast path."""
+    chunked staging feeding the Trainer's multi-step fast path. With
+    cfg.feed_workers > 0 (FEED_WORKERS env) the host side of the producer
+    (gather + collate) runs on a shared-memory worker pool
+    (dcnn_tpu/data/workers.py; tuning guide docs/performance.md)."""
     from dcnn_tpu.data import PrefetchLoader
 
     return PrefetchLoader(loader, depth=2,
-                          stage_batches=max(cfg.steps_per_dispatch, 1))
+                          stage_batches=max(cfg.steps_per_dispatch, 1),
+                          feed_workers=max(cfg.feed_workers, 0))
 
 
 def prepare_input(train_loader, val_loader, num_classes, cfg,

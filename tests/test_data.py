@@ -234,6 +234,80 @@ def test_flip_determinism_and_correctness():
     np.testing.assert_array_equal(flipped, x[..., ::-1])
 
 
+def test_augment_ops_never_mutate_input():
+    """Regression (r6): cutout/flips/rotation/random_crop used to write
+    into the caller's batch, corrupting the source array for
+    non-augmented consumers sharing it. Every op is copy-on-write now."""
+    from dcnn_tpu.data import (brightness, contrast, cutout, gaussian_noise,
+                               horizontal_flip, normalization, random_crop,
+                               rotation, vertical_flip)
+
+    ops = [brightness(0.5, p=1.0), contrast(0.5, 1.5, p=1.0),
+           cutout(4, p=1.0), gaussian_noise(0.1, p=1.0),
+           horizontal_flip(p=1.0), vertical_flip(p=1.0),
+           normalization([0.5] * 3, [0.25] * 3), random_crop(2, p=1.0),
+           rotation(10.0, p=1.0)]
+    rng_src = np.random.default_rng(3)
+    x = rng_src.random((6, 3, 12, 12)).astype(np.float32)
+    x0 = x.copy()
+    for op in ops:
+        out = op(x, np.random.default_rng(0))
+        np.testing.assert_array_equal(
+            x, x0, err_msg=f"{type(op).__name__} mutated its input")
+        assert not np.array_equal(out, x), type(op).__name__
+    # p=0 ops return the input unchanged (no pointless copy)
+    for op in [cutout(4, p=0.0), horizontal_flip(p=0.0),
+               vertical_flip(p=0.0), rotation(10.0, p=0.0)]:
+        assert op(x, np.random.default_rng(0)) is x
+
+
+def test_random_crop_vectorized_matches_windowed_reference():
+    """The batched-offset random_crop picks the same windows a per-image
+    loop with the same draw order would (mask draw, then the two batched
+    offset draws), for both layouts."""
+    from dcnn_tpu.data import random_crop
+
+    for fmt, shape in (("NCHW", (5, 2, 9, 7)), ("NHWC", (5, 9, 7, 2))):
+        x = np.random.default_rng(1).random(shape).astype(np.float32)
+        pad = 2
+        out = random_crop(pad, p=1.0, data_format=fmt)(
+            x, np.random.default_rng(42))
+        ref_rng = np.random.default_rng(42)
+        n = len(x)
+        _ = ref_rng.random(n)                 # the apply mask (p=1 -> all)
+        oy = ref_rng.integers(0, 2 * pad + 1, size=n)
+        ox = ref_rng.integers(0, 2 * pad + 1, size=n)
+        ha, wa = (2, 3) if fmt == "NCHW" else (1, 2)
+        h, w = shape[ha], shape[wa]
+        pad_spec = [(0, 0)] * 4
+        pad_spec[ha] = pad_spec[wa] = (pad, pad)
+        padded = np.pad(x, pad_spec)
+        for i in range(n):
+            if fmt == "NCHW":
+                want = padded[i, :, oy[i]:oy[i] + h, ox[i]:ox[i] + w]
+            else:
+                want = padded[i, oy[i]:oy[i] + h, ox[i]:ox[i] + w, :]
+            np.testing.assert_array_equal(out[i], want)
+
+
+def test_augment_strategy_picklable():
+    """Worker processes receive the augmentation recipe by pickle under
+    spawn — every built-in op must round-trip and draw identically."""
+    import pickle
+
+    strategy = (AugmentationBuilder("NHWC")
+                .brightness(0.3, p=0.7).contrast(0.7, 1.3, p=0.5)
+                .cutout(3, p=0.5).gaussian_noise(0.05, p=0.5)
+                .horizontal_flip(p=0.5).vertical_flip(p=0.5)
+                .normalization([0.5], [0.25]).random_crop(2, p=1.0)
+                .rotation(5.0, p=0.5).build())
+    clone = pickle.loads(pickle.dumps(strategy))
+    x = np.random.default_rng(2).random((4, 8, 8, 1)).astype(np.float32)
+    a = strategy(x, np.random.default_rng(9))
+    b = clone(x, np.random.default_rng(9))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_loader_augmentation_hook_applied():
     x = np.ones((8, 3, 8, 8), np.float32)
     y = one_hot(np.zeros(8, np.int64), 2)

@@ -1,0 +1,433 @@
+"""Parallel host input pipeline tests (data/workers.py): bit-identity of
+the worker-pool feed vs the serial path for every worker count, slot-ring
+back-pressure, worker-failure fallback under FaultPlan trip points, and
+the multiprocess soak (slow).
+
+Tier-1 tests run the THREAD backend over the LocalSlots fake allocator —
+same scheduler, ordering, rng derivation and fallback machinery as the
+process backend, with no interpreter forks and no sleeps; the real
+multiprocess pool is covered by the ``slow``-marked soak."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.data import AugmentationBuilder
+from dcnn_tpu.data.workers import (FeedWorkerPool, LocalSlots, ShmSlots,
+                                   prepare_shard, serial_shards, shard_rng)
+from dcnn_tpu.obs import Tracer, get_registry
+from dcnn_tpu.resilience import faults
+
+
+def _data(n=256, hw=8, c=3, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n, hw, hw, c), dtype=np.uint8)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def _sels(n, rows, k, seed=1):
+    rng = np.random.default_rng(seed)
+    return [np.sort(rng.permutation(n)[:rows]) for _ in range(k)]
+
+
+def _aug():
+    return (AugmentationBuilder("NHWC").horizontal_flip(p=0.5)
+            .random_crop(2, p=1.0).brightness(0.2, p=0.5).build())
+
+
+def _local_slots(x, y, rows, num_slots):
+    return LocalSlots(num_slots, rows, x.shape[1:], x.dtype,
+                      y.shape[1:], y.dtype)
+
+
+def _collect(pool, sels, epoch=0):
+    out = []
+    for ps in pool.shards(sels, epoch=epoch):
+        out.append((ps.x.copy(), ps.y.copy()))
+        ps.release()
+    return out
+
+
+# -- deterministic preparation ----------------------------------------------
+
+def test_shard_rng_depends_on_cell_not_worker():
+    a = shard_rng(7, 2, 5).random(8)
+    b = shard_rng(7, 2, 5).random(8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, shard_rng(7, 2, 6).random(8))
+    assert not np.array_equal(a, shard_rng(7, 3, 5).random(8))
+    assert not np.array_equal(a, shard_rng(8, 2, 5).random(8))
+
+
+def test_prepare_shard_matches_fancy_index():
+    x, y = _data()
+    sel = _sels(len(x), 64, 1)[0]
+    xg, yg, t = prepare_shard(x, y, sel)
+    np.testing.assert_array_equal(xg, x[sel])
+    np.testing.assert_array_equal(yg, y[sel])
+    assert t["augment_s"] == 0.0 and t["rows"] == 64
+    # gathering straight into out buffers is bit-identical
+    out_x = np.empty_like(xg)
+    out_y = np.empty_like(yg)
+    prepare_shard(x, y, sel, out_x=out_x, out_y=out_y)
+    np.testing.assert_array_equal(out_x, x[sel])
+    np.testing.assert_array_equal(out_y, y[sel])
+
+
+def test_prepare_shard_augment_deterministic_and_nonmutating():
+    x, y = _data()
+    x0 = x.copy()
+    sel = _sels(len(x), 64, 1)[0]
+    aug = _aug()
+    a, _, _ = prepare_shard(x, y, sel, augment=aug, rng=shard_rng(3, 1, 0))
+    b, _, _ = prepare_shard(x, y, sel, augment=aug, rng=shard_rng(3, 1, 0))
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.uint8            # uint8 wire format survives
+    assert not np.array_equal(a, x[sel])  # augmentation actually applied
+    np.testing.assert_array_equal(x, x0)  # source dataset untouched
+    with pytest.raises(ValueError, match="requires rng"):
+        prepare_shard(x, y, sel, augment=aug)
+
+
+def test_prepare_shard_float_dataset_keeps_dtype():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4, 4, 1)).astype(np.float32)
+    y = rng.integers(0, 3, size=64).astype(np.int32)
+    sel = np.arange(16, dtype=np.int64)
+    xg, _, _ = prepare_shard(x, y, sel, augment=_aug(),
+                             rng=shard_rng(0, 0, 0))
+    assert xg.dtype == np.float32
+
+
+# -- bit-identity across worker counts (the hard contract) ------------------
+
+@pytest.mark.parametrize("augmented", [False, True])
+def test_pool_bit_identical_to_serial_any_worker_count(augmented):
+    x, y = _data()
+    sels = _sels(len(x), 64, 6)
+    aug = _aug() if augmented else None
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, augment=aug, seed=7, epoch=3)]
+    for nw in (1, 4):
+        pool = FeedWorkerPool(
+            x, y, 64, num_workers=nw, augment=aug, seed=7,
+            backend="thread", poll_s=0.02,
+            slots=_local_slots(x, y, 64, nw + 2))
+        got = _collect(pool, sels, epoch=3)
+        pool.close()
+        assert len(got) == len(ser)
+        for (sx, sy), (gx, gy) in zip(ser, got):
+            np.testing.assert_array_equal(sx, gx)
+            np.testing.assert_array_equal(sy, gy)
+
+
+def test_pool_zero_workers_is_serial_path():
+    x, y = _data()
+    sels = _sels(len(x), 32, 3)
+    pool = FeedWorkerPool(x, y, 32, num_workers=0, augment=_aug(), seed=2)
+    got = _collect(pool, sels, epoch=1)
+    ser = [(a, b) for a, b, _ in
+           serial_shards(x, y, sels, augment=_aug(), seed=2, epoch=1)]
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+    pool.close()
+
+
+def test_pool_epoch_changes_augment_draws():
+    x, y = _data()
+    sels = _sels(len(x), 32, 2)
+    with FeedWorkerPool(x, y, 32, num_workers=2, augment=_aug(), seed=2,
+                        backend="thread", poll_s=0.02) as pool:
+        e0 = _collect(pool, sels, epoch=0)
+        e1 = _collect(pool, sels, epoch=1)
+    assert not all(np.array_equal(a, c) for (a, _), (c, _) in zip(e0, e1))
+
+
+# -- slot ring: back-pressure + bookkeeping ---------------------------------
+
+def test_backpressure_bounded_by_slots():
+    x, y = _data()
+    sels = _sels(len(x), 32, 4)
+    pool = FeedWorkerPool(x, y, 32, num_workers=1, seed=0,
+                          backend="thread", poll_s=0.02, num_slots=2)
+    it = pool.shards(sels)
+    ps0 = next(it)
+    ps1 = next(it)
+    assert pool._free.qsize() == 0  # both slots leased, nothing free
+    got = {}
+
+    def pull():
+        got["ps"] = next(it)
+
+    t = threading.Thread(target=pull, daemon=True)
+    t.start()
+    t.join(0.3)
+    assert t.is_alive(), "third shard yielded without a free slot"
+    ps0.release()                    # free one slot -> shard 2 can flow
+    t.join(10.0)
+    assert not t.is_alive() and got["ps"].idx == 2
+    ps1.release()
+    got["ps"].release()
+    for ps in it:
+        ps.release()
+    assert pool._free.qsize() == 2   # ring fully recycled
+    pool.close()
+
+
+def test_pool_rejects_oversized_shard_and_double_iter():
+    x, y = _data()
+    pool = FeedWorkerPool(x, y, 16, num_workers=1, backend="thread",
+                          poll_s=0.02)
+    with pytest.raises(ValueError, match="exceeds"):
+        list(pool.shards([np.arange(32, dtype=np.int64)]))
+    pool.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        list(pool.shards([np.arange(4, dtype=np.int64)]))
+
+
+def test_registry_instruments_settle():
+    x, y = _data()
+    reg = get_registry()
+    shards0 = reg.counter("feed_shards_total").value
+    sels = _sels(len(x), 32, 5)
+    with FeedWorkerPool(x, y, 32, num_workers=2, backend="thread",
+                        poll_s=0.02) as pool:
+        for ps in pool.shards(sels):
+            ps.release()
+    assert reg.counter("feed_shards_total").value == shards0 + 5
+    assert reg.gauge("feed_queue_depth").value == 0
+    assert reg.gauge("feed_workers_busy").value == 0
+
+
+def test_worker_spans_on_per_worker_tracks():
+    x, y = _data()
+    tracer = Tracer(enabled=True)
+    sels = _sels(len(x), 32, 4)
+    with FeedWorkerPool(x, y, 32, num_workers=2, augment=_aug(), seed=0,
+                        backend="thread", poll_s=0.02,
+                        tracer=tracer) as pool:
+        for ps in pool.shards(sels):
+            ps.release()
+    evs = tracer.events()
+    names = {e["name"] for e in evs}
+    assert {"feed.gather", "feed.augment", "feed.pack"} <= names
+    tracks = {e["track"] for e in evs if e["name"] == "feed.gather"}
+    assert tracks <= {"feed-w0", "feed-w1"} and tracks
+    for e in evs:
+        assert e["dur_s"] >= 0.0
+
+
+# -- failure paths ----------------------------------------------------------
+
+def test_worker_error_falls_back_inline_bit_identical():
+    x, y = _data()
+    sels = _sels(len(x), 64, 6)
+    aug = _aug()
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, augment=aug, seed=7, epoch=0)]
+    reg = get_registry()
+    f0 = reg.counter("feed_worker_failures_total").value
+    plan = faults.FaultPlan().arm("feed.prepare", at=2, times=1)
+    with plan:
+        with FeedWorkerPool(x, y, 64, num_workers=2, augment=aug, seed=7,
+                            backend="thread", poll_s=0.02) as pool:
+            got = _collect(pool, sels)
+            assert pool.alive_workers() == 2  # error != death
+    assert reg.counter("feed_worker_failures_total").value == f0 + 1
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+def test_worker_crash_detected_and_epoch_completes():
+    x, y = _data()
+    sels = _sels(len(x), 64, 6)
+    aug = _aug()
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, augment=aug, seed=7, epoch=0)]
+    reg = get_registry()
+    f0 = reg.counter("feed_worker_failures_total").value
+    plan = faults.FaultPlan().arm("feed.prepare", at=1, times=1,
+                                  exc=faults.InjectedCrash)
+    with plan:
+        with FeedWorkerPool(x, y, 64, num_workers=2, augment=aug, seed=7,
+                            backend="thread", poll_s=0.02) as pool:
+            got = _collect(pool, sels)
+            assert pool.alive_workers() == 1  # one worker died silently
+    assert reg.counter("feed_worker_failures_total").value > f0
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+def test_all_workers_dead_degrades_to_inline():
+    x, y = _data()
+    sels = _sels(len(x), 64, 5)
+    ser = [(a.copy(), b.copy()) for a, b, _ in serial_shards(x, y, sels)]
+    plan = faults.FaultPlan().arm("feed.prepare", exc=faults.InjectedCrash)
+    with plan:
+        with FeedWorkerPool(x, y, 64, num_workers=2, seed=0,
+                            backend="thread", poll_s=0.02) as pool:
+            got = _collect(pool, sels)
+            assert pool.alive_workers() == 0
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+def test_stall_rescue_settles_slot_and_respects_busy_workers():
+    """White-box: the stall scavenger (a) skips rescue while any live
+    worker is mid-shard (queued tasks are waiting, not lost), (b) rescues
+    unclaimed shards out of inflight — so the epoch TERMINATES — into the
+    poisoned-slot ledger, and (c) recycles the slot when the late worker
+    result eventually lands."""
+    x, y = _data()
+    pool = FeedWorkerPool(x, y, 32, num_workers=1, backend="thread",
+                          poll_s=0.02)
+    sel = np.arange(32, dtype=np.int64)
+    try:
+        sid = pool._free.get_nowait()
+        inflight = {0: {"slot": sid, "sel": sel, "wid": None}}
+        # (a) a live worker is busy -> no rescue
+        pool._busy.add(0)
+        pool._rescue_stalled(inflight, {}, epoch=9)
+        assert 0 in inflight
+        # (b) all idle -> rescued inline, inflight emptied (termination),
+        # slot parked in the poisoned ledger
+        pool._busy.clear()
+        ready = {}
+        pool._rescue_stalled(inflight, ready, epoch=9)
+        assert inflight == {} and ready[0]["arrays"] is not None
+        np.testing.assert_array_equal(ready[0]["arrays"][0], x[sel])
+        assert pool._poisoned == {(9, 0): sid}
+        # (c) the late worker result finally releases the slot
+        free0 = pool._free.qsize()
+        pool._result_q.put(("done", 0, 9, 0, {"worker": 0}))
+        pool._pump({}, {}, epoch=9)
+        assert pool._free.qsize() == free0 + 1 and pool._poisoned == {}
+    finally:
+        pool.close()
+
+
+def test_abandoned_epoch_reclaims_slots():
+    x, y = _data()
+    sels = _sels(len(x), 32, 6)
+    with FeedWorkerPool(x, y, 32, num_workers=2, backend="thread",
+                        poll_s=0.02, num_slots=3) as pool:
+        it = pool.shards(sels)
+        ps = next(it)
+        ps.release()
+        it.close()                      # consumer bails mid-epoch
+        got = _collect(pool, sels)      # ring must be whole again
+        assert len(got) == 6
+        assert pool._free.qsize() == 3
+
+
+# -- process backend (kept small for tier-1; the soak is slow) --------------
+
+@pytest.mark.skipif("fork" not in __import__("multiprocessing")
+                    .get_all_start_methods(),
+                    reason="no fork on this platform")
+def test_process_pool_bit_identity_small():
+    x, y = _data(n=128)
+    sels = _sels(len(x), 32, 4)
+    aug = _aug()
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, augment=aug, seed=5, epoch=1)]
+    with FeedWorkerPool(x, y, 32, num_workers=2, augment=aug, seed=5,
+                        poll_s=0.05) as pool:
+        got = _collect(pool, sels, epoch=1)
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+def test_shm_slots_lifecycle():
+    slots = ShmSlots(2, 8, (4, 4, 3), np.uint8, (), np.int32)
+    spec = slots.spec()
+    att = ShmSlots.attach(spec)
+    v = slots.x_view(0, 8)
+    v[...] = 7
+    np.testing.assert_array_equal(att.x_view(0, 8), v)
+    yv = slots.y_view(1, 8)
+    yv[...] = np.arange(8, dtype=np.int32)
+    np.testing.assert_array_equal(att.y_view(1, 8), yv)
+    del v, yv
+    att.close()
+    slots.close()  # owner unlinks; attach after unlink must fail
+    with pytest.raises(FileNotFoundError):
+        ShmSlots.attach(spec)
+
+
+# -- slow: the real multiprocess soak ---------------------------------------
+
+@pytest.mark.slow
+def test_multiprocess_soak_bit_identity_and_crash():
+    x, y = _data(n=1024, hw=16)
+    sels = _sels(len(x), 128, 8)
+    aug = _aug()
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, augment=aug, seed=9, epoch=4)]
+    # several epochs through one pool (slot recycling under load)
+    with FeedWorkerPool(x, y, 128, num_workers=4, augment=aug, seed=9,
+                        poll_s=0.05) as pool:
+        for _ in range(3):
+            got = _collect(pool, sels, epoch=4)
+            for (sx, sy), (gx, gy) in zip(ser, got):
+                np.testing.assert_array_equal(sx, gx)
+                np.testing.assert_array_equal(sy, gy)
+    # crash soak: fork inherits the armed plan; each worker hard-exits
+    # (os._exit) on its second task — the epoch must still complete
+    # bit-identically via inline fallback
+    reg = get_registry()
+    f0 = reg.counter("feed_worker_failures_total").value
+    plan = faults.FaultPlan().arm("feed.prepare", at=1, times=1,
+                                  exc=faults.InjectedCrash)
+    with plan:
+        with FeedWorkerPool(x, y, 128, num_workers=2, augment=aug, seed=9,
+                            poll_s=0.05, mp_context="fork") as pool:
+            got = _collect(pool, sels, epoch=4)
+            assert pool.alive_workers() == 0
+    assert reg.counter("feed_worker_failures_total").value > f0
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif((os.cpu_count() or 1) < 4,
+                    reason="speedup gate needs >= 4 cores")
+def test_parallel_prep_speedup_over_serial():
+    """Acceptance gate: gather+augment+pack throughput with 4 workers is
+    >= 2x serial on a >= 4-core host, augmentation enabled."""
+    import time
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(4096, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 100, size=4096).astype(np.int32)
+    sels = _sels(len(x), 512, 8, seed=2)
+    aug = (AugmentationBuilder("NHWC").horizontal_flip(p=0.5)
+           .random_crop(2, p=1.0).rotation(10.0, p=1.0).build())
+
+    t0 = time.perf_counter()
+    for _ in serial_shards(x, y, sels, augment=aug, seed=1, epoch=0):
+        pass
+    serial_s = time.perf_counter() - t0
+
+    with FeedWorkerPool(x, y, 512, num_workers=4, augment=aug, seed=1,
+                        poll_s=0.05) as pool:
+        # warm pass: fork + fault-free path settled before timing
+        for ps in pool.shards(sels, epoch=0):
+            ps.release()
+        t0 = time.perf_counter()
+        for ps in pool.shards(sels, epoch=0):
+            ps.release()
+        pool_s = time.perf_counter() - t0
+
+    speedup = serial_s / pool_s
+    assert speedup >= 2.0, (f"parallel prep speedup {speedup:.2f}x < 2x "
+                            f"(serial {serial_s:.2f}s, pool {pool_s:.2f}s)")

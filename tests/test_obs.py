@@ -175,6 +175,24 @@ def test_tracer_fake_clock_exact():
     assert evs[0]["track"] == "x" and evs[0]["args"] == {"k": 1}
 
 
+def test_tracer_record_span_replay():
+    """record_span replays externally-measured intervals (feed workers
+    stamp phases in their own process; the parent lands them on per-worker
+    tracks) — timestamps interpreted in the tracer's clock domain."""
+    fc = FakeClock()
+    t = Tracer(clock=fc, enabled=True)
+    t.record_span("feed.gather", 1.0, 1.5, track="feed-w3", shard=2)
+    t.record_span("feed.pack", 2.0, 2.0, track="feed-w3")
+    evs = t.events()
+    assert evs[0] == {"name": "feed.gather", "ts_s": 1.0, "dur_s": 0.5,
+                      "track": "feed-w3", "args": {"shard": 2}}
+    assert evs[1]["dur_s"] == 0.0
+    # disabled tracer: the swapped-in null fn records nothing
+    t.set_enabled(False)
+    t.record_span("feed.gather", 3.0, 4.0, track="feed-w3")
+    assert len(t.events()) == 2
+
+
 def test_tracer_cross_thread_begin_end():
     fc = FakeClock()
     t = Tracer(clock=fc, enabled=True)

@@ -141,6 +141,156 @@ def test_prefetch_sharded_placement():
     assert len(x.addressable_shards) == 4
 
 
+def test_prefetch_stage_engine_device_transform_bit_identity():
+    """The full combination — stage_batches>1 x transfer_engine x
+    device_transform — must yield bit-identical arrays to the plain
+    (no-engine) path: chunked shipment + on-device concat is pure data
+    movement."""
+    from dcnn_tpu.data import TransferEngine
+
+    x = np.arange(40 * 4, dtype=np.uint8).reshape(40, 4)
+    y = (np.arange(40) % 3).astype(np.int32)
+
+    def mk():
+        ld = ArrayDataLoader(x, y, batch_size=8, shuffle=True, seed=5)
+        ld.load_data()
+        return ld
+
+    decode = jax.jit(lambda xu, yi: (xu.astype(jnp.float32) / 255.0,
+                                     jax.nn.one_hot(yi, 3)))
+    plain = list(PrefetchLoader(mk(), depth=2, stage_batches=2,
+                                device_transform=decode))
+    with TransferEngine(num_chunks=3, num_threads=2,
+                        reassemble="concat") as eng:
+        chunked = list(PrefetchLoader(mk(), depth=2, stage_batches=2,
+                                      device_transform=decode,
+                                      transfer_engine=eng))
+    assert len(plain) == len(chunked) == 3  # 5 batches -> [2, 2, 1]
+    for (px, py), (cx, cy) in zip(plain, chunked):
+        np.testing.assert_array_equal(np.asarray(px), np.asarray(cx))
+        np.testing.assert_array_equal(np.asarray(py), np.asarray(cy))
+
+
+def test_prefetch_staged_engine_producer_error_propagates():
+    """A producer-thread failure must reach the consumer through the
+    staging + transfer-engine path too, not only the plain one."""
+    from dcnn_tpu.data import TransferEngine
+
+    class Boom:
+        batch_size = 4
+        num_samples = 16
+
+        def __iter__(self):
+            yield (np.zeros((4, 2), np.float32), np.zeros((4,), np.int32))
+            yield (np.zeros((4, 2), np.float32), np.zeros((4,), np.int32))
+            raise RuntimeError("gather exploded")
+
+    with TransferEngine(num_chunks=2, num_threads=1,
+                        reassemble="concat") as eng:
+        with pytest.raises(RuntimeError, match="gather exploded"):
+            list(PrefetchLoader(Boom(), depth=2, stage_batches=2,
+                                transfer_engine=eng))
+
+
+def test_prefetch_pooled_bit_identity_and_close():
+    """feed_workers delegation yields bit-identical batches to the serial
+    producer (no worker augment), across stage sizes and epochs."""
+    x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+    y = np.eye(2, dtype=np.float32)[np.arange(64) % 2]
+
+    def mk():
+        ld = ArrayDataLoader(x, y, batch_size=8, shuffle=True, seed=3)
+        ld.load_data()
+        return ld
+
+    for stage in (1, 3):
+        plain_pf = PrefetchLoader(mk(), depth=2, stage_batches=stage)
+        pooled_pf = PrefetchLoader(mk(), depth=2, stage_batches=stage,
+                                   feed_workers=2)
+        with pooled_pf:
+            for epoch in (0, 1):
+                plain_pf.shuffle(epoch)
+                pooled_pf.shuffle(epoch)
+                plain = list(plain_pf)
+                pooled = list(pooled_pf)
+                assert len(plain) == len(pooled)
+                for (px, py), (qx, qy) in zip(plain, pooled):
+                    np.testing.assert_array_equal(np.asarray(px),
+                                                  np.asarray(qx))
+                    np.testing.assert_array_equal(np.asarray(py),
+                                                  np.asarray(qy))
+        pooled_pf.close()  # idempotent
+
+
+def test_prefetch_pooled_ragged_tail_matches_plain():
+    x = np.arange(20 * 4, dtype=np.float32).reshape(20, 4)
+    y = np.eye(2, dtype=np.float32)[np.arange(20) % 2]
+
+    def mk():
+        ld = ArrayDataLoader(x, y, batch_size=8, shuffle=False,
+                             drop_last=False)
+        ld.load_data()
+        return ld
+
+    plain = list(PrefetchLoader(mk(), depth=2, stage_batches=3))
+    with PrefetchLoader(mk(), depth=2, stage_batches=3,
+                        feed_workers=2) as pf:
+        pooled = list(pf)
+    assert ([tuple(c[0].shape[:2]) for c in pooled]
+            == [tuple(c[0].shape[:2]) for c in plain] == [(2, 8), (1, 4)])
+    for (px, _), (qx, _) in zip(plain, pooled):
+        np.testing.assert_array_equal(np.asarray(px), np.asarray(qx))
+
+
+def test_prefetch_pooled_worker_augment_deterministic():
+    from dcnn_tpu.data import AugmentationBuilder
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(64, 8, 8, 1), dtype=np.uint8)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    aug = AugmentationBuilder("NHWC").horizontal_flip(p=0.5).build()
+
+    def run(workers):
+        ld = ArrayDataLoader(x, y, batch_size=8, shuffle=True, seed=2)
+        ld.load_data()
+        with PrefetchLoader(ld, depth=2, stage_batches=2,
+                            feed_workers=workers,
+                            worker_augment=aug) as pf:
+            return [(np.asarray(a).copy(), np.asarray(b).copy())
+                    for a, b in pf]
+
+    one, four = run(1), run(4)
+    for (ax, ay), (bx, by) in zip(one, four):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+
+
+def test_prefetch_pooled_rejects_incompatible_hooks():
+    x = np.zeros((16, 4), np.float32)
+    y = np.zeros((16, 2), np.float32)
+    ld = ArrayDataLoader(x, y, batch_size=4, shuffle=False,
+                         augmentation=lambda b, r: b)
+    ld.load_data()
+    with pytest.raises(ValueError, match="transform"):
+        PrefetchLoader(ld, feed_workers=2, transform=lambda a, b: (a, b))
+    pf = PrefetchLoader(ld, feed_workers=2)
+    with pytest.raises(ValueError, match="worker_augment"):
+        list(pf)
+    pf.close()
+
+    class NoArrays:
+        batch_size = 4
+        num_samples = 8
+
+        def __iter__(self):
+            return iter([])
+
+    pf = PrefetchLoader(NoArrays(), feed_workers=2)
+    with pytest.raises(ValueError, match="BaseDataLoader-style"):
+        list(pf)
+    pf.close()
+
+
 def test_parallel_decode_matches_serial(tmp_path):
     PIL = pytest.importorskip("PIL")
     from PIL import Image
