@@ -14,7 +14,16 @@ numbers (BASELINE.md).
 Extra reported fields: achieved model TFLOP/s and MFU (from the model's own
 analytic FLOP count — forward_complexity x3 for fwd+bwd, the standard
 training-FLOPs convention), per-step latency, and with BENCH_MATRIX=1 a
-layout x dtype sweep (NCHW/NHWC x fp32/bf16).
+layout x dtype sweep (NCHW/NHWC x fp32/bf16). Since r6 the capture also
+carries `mfu_analytic` (XLA cost_analysis FLOPs of the actual compiled
+step executable — reported NEXT TO the formula value `mfu_formula` for one
+release; `mfu` stays the formula figure the r01-r05 trajectory gates on),
+`roofline_bytes_per_flop` + `phases.xla_cost` (the executable's
+bytes-accessed/FLOP roofline coordinate), a `telemetry_essentials` block
+(compile_total/compile_seconds_total counters, HBM watermark, h2d gauges —
+always on, no trace artifact needed), and a `regressions` block: the
+newest-vs-trailing-window verdict from dcnn_tpu/obs/regress.py
+(standalone CLI: benchmarks/compare.py).
 
 Runs the full jitted train step (forward+backward+Adam update) on synthetic
 data resident in HBM, so the number isolates compute+HBM (the reference's
@@ -230,6 +239,30 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
     compile_warm_s = time.perf_counter() - t0
     step2 = multi2 = None
 
+    # XLA's own accounting of the headline executable (dcnn_tpu/obs/xla):
+    # post-fusion FLOPs + bytes-accessed from cost_analysis() feed the
+    # analytic MFU (mfu_analytic, reported next to the forward_complexity
+    # formula value) and the roofline byte/FLOP ratio; the compile walls
+    # land on the compile_total/compile_seconds_total counters the AOT
+    # cache work (ROADMAP item 4) is judged against
+    from dcnn_tpu.obs.xla import jit_cost, record_compile
+    record_compile(compile_s, what="train")
+    record_compile(compile_warm_s, what="train_warm")
+    jitted = multi if chunk > 1 else step
+    xla_cost = jit_cost(jitted, ts, x, y, jax.random.fold_in(key, 0), 1e-3)
+    if xla_cost is not None:
+        imgs_per_dispatch = batch * (chunk if chunk > 1 else 1)
+        if xla_cost.get("flops"):
+            xla_cost["flops_per_img"] = xla_cost["flops"] / imgs_per_dispatch
+        from dcnn_tpu.obs import get_registry
+        _reg = get_registry()
+        for k, gname in (("flops", "train_step_flops"),
+                         ("bytes_accessed", "train_step_bytes_accessed"),
+                         ("bytes_per_flop", "train_step_bytes_per_flop")):
+            if xla_cost.get(k) is not None:
+                _reg.gauge(gname, f"XLA cost analysis: {k} of the headline "
+                                  f"train executable").set(xla_cost[k])
+
     if profile_dir:
         with jax.profiler.trace(profile_dir):
             _, ts, _ = _measure(step, ts, x, y, key, min(dispatches, 5), 1)
@@ -241,7 +274,11 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
               "compile_warm_s": round(compile_warm_s, 3),
               "warmup_s": round(warmup_s, 3),
               "rep_s": [round(r, 4) for r in rep_times],
-              "steps_per_rep": steps}
+              "steps_per_rep": steps,
+              "xla_cost": ({k: (round(v, 6) if k == "bytes_per_flop"
+                                else round(v, 1))
+                            for k, v in xla_cost.items()}
+                           if xla_cost is not None else None)}
     # release the headline working set (the staged K-batch chunk is ~4 GB
     # fp32 at batch 4096×20) before the feed sections allocate their own —
     # holding both exceeds HBM at the larger default batch
@@ -778,6 +815,14 @@ def main() -> None:
     precision = os.environ.get("DCNN_PRECISION", "bf16").lower()
     mfu = (round(tflops / peak, 4)
            if peak and precision in ("fast", "bf16") else None)
+    # cost-analysis-derived MFU reported NEXT TO the forward_complexity()x3
+    # formula value for one release (mfu itself stays the formula figure
+    # the r01-r05 trajectory and its regression gate were built on; it
+    # switches to the analytic value once r06+ captures carry both)
+    from dcnn_tpu.obs.xla import analytic_mfu
+    xc = phases.get("xla_cost") or {}
+    mfu_analytic = (analytic_mfu(xc.get("flops_per_img"), img_per_sec, peak)
+                    if peak and precision in ("fast", "bf16") else None)
 
     baseline_kind, baseline = _load_measured_baseline(root)
     if baseline is not None:
@@ -800,6 +845,10 @@ def main() -> None:
         "sec_per_step": round(sec_per_step, 4),
         "model_tflops_per_sec": round(tflops, 2),
         "mfu": mfu,
+        "mfu_formula": mfu,
+        "mfu_analytic": (round(mfu_analytic, 4)
+                         if mfu_analytic is not None else None),
+        "roofline_bytes_per_flop": xc.get("bytes_per_flop"),
         "device_kind": device_kind,
         "batch": batch,
         "format": data_format,
@@ -871,8 +920,33 @@ def main() -> None:
         set_precision(precision)
         out["matrix"] = matrix
 
+    # always-persisted telemetry essentials (unconditionally cheap — no
+    # tracing required): compile counters, HBM watermark, h2d gauges, the
+    # cost-analysis series. This is the block that makes BENCH_r06+
+    # captures regression-gate-ready without the BENCH_OBS=1 trace
+    # artifact.
+    from dcnn_tpu.obs import get_registry
+    from dcnn_tpu.obs.xla import sample_hbm
+
+    reg = get_registry()
+    hbm = sample_hbm(reg) or {}
+    snap = reg.snapshot()
+    out["telemetry_essentials"] = {
+        "compile_total": snap.get("compile_total", 0),
+        "compile_seconds_total": round(
+            float(snap.get("compile_seconds_total", 0.0)), 3),
+        "hbm_peak_bytes": hbm.get("hbm_peak_bytes"),
+        "hbm_bytes_in_use": hbm.get("hbm_bytes_in_use"),
+        "hbm_bytes_limit": hbm.get("hbm_bytes_limit"),
+        "h2d_gbps": out.get("h2d_gbps"),
+        "h2d_gbps_effective": (streaming_timeline or {}).get(
+            "h2d_gbps_effective"),
+        "train_step_bytes_per_flop": snap.get("train_step_bytes_per_flop"),
+        "serve_flops_per_sample": snap.get("serve_flops_per_sample"),
+    }
+
     if obs_on:
-        from dcnn_tpu.obs import get_registry, get_tracer
+        from dcnn_tpu.obs import get_tracer
 
         tracer = get_tracer()
         trace_path = os.environ.get("BENCH_OBS_TRACE",
@@ -884,6 +958,14 @@ def main() -> None:
             "spans": tracer.span_counts(),
             "metrics": get_registry().snapshot(),
         }
+
+    # bench-history regression gate (dcnn_tpu/obs/regress.py;
+    # benchmarks/compare.py is the standalone CLI): this run's numbers
+    # against the trailing BENCH_r*.json window, embedded in the capture
+    # so every BENCH_r06+ file carries its own verdict. Informational
+    # here — the CLI is where a CI job turns it into an exit code.
+    from dcnn_tpu.obs.regress import gate_current
+    out["regressions"] = gate_current(out, root)
 
     print(json.dumps(out))
 

@@ -74,6 +74,13 @@ class TrainingConfig:
     stall_timeout_s: float = 0.0      # >0: StallWatchdog flags a hung
                                       # step/data fetch on the obs registry
 
+    # -- external telemetry (dcnn_tpu/obs/server.py; docs/observability.md)
+    metrics_port: int = -1            # >=0: serve /metrics + /healthz +
+                                      # /snapshot over HTTP for the whole
+                                      # fit() (0 = ephemeral port; -1 = off).
+                                      # healthz wires the stall watchdog and
+                                      # checkpoint health automatically
+
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
         """Environment-variable mapping mirroring ``train.hpp:80-100``."""
@@ -104,6 +111,7 @@ class TrainingConfig:
             nonfinite_policy=get_env("NONFINITE_POLICY", base.nonfinite_policy),
             rollback_after=get_env("ROLLBACK_AFTER", base.rollback_after),
             stall_timeout_s=get_env("STALL_TIMEOUT_S", base.stall_timeout_s),
+            metrics_port=get_env("METRICS_PORT", base.metrics_port),
         )
 
     def to_dict(self) -> dict:

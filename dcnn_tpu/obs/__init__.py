@@ -26,15 +26,38 @@ stack's enqueue → dispatch → infer decomposition. ``BENCH_OBS=1 python
 bench.py`` writes the Chrome trace artifact and embeds a telemetry block
 in the bench JSON. Workflow guide: ``docs/observability.md``.
 
-This package is stdlib-only (no jax import) — safe to import from any
-layer, including before backend selection.
+Since PR 6 the package also carries the EXPORT half of observability —
+the pieces that let the outside world see a process (docs/observability.md
+"External scraping"):
+
+- :mod:`~dcnn_tpu.obs.server` — :class:`TelemetryServer`: a stdlib
+  threaded HTTP server exposing ``/metrics`` (Prometheus text),
+  ``/healthz`` (200/503 liveness + resilience checks) and ``/snapshot``
+  (JSON registry + recent spans); wired into ``Trainer``
+  (``TrainingConfig.metrics_port``) and ``DynamicBatcher.start_telemetry``
+  so a future replica router can scrape every replica.
+- :mod:`~dcnn_tpu.obs.exposition` — the ONE Prometheus text renderer
+  both ``MetricsRegistry.prometheus`` and ``ServeMetrics.prometheus``
+  share.
+- :mod:`~dcnn_tpu.obs.xla` — compiled-executable introspection: XLA
+  ``cost_analysis`` FLOPs/bytes (analytic MFU + roofline byte/FLOP),
+  ``compile_total``/``compile_seconds_total`` counters, HBM watermark
+  gauges. (Imports jax lazily — this package stays importable first.)
+- :mod:`~dcnn_tpu.obs.regress` — the BENCH_r*.json trajectory regression
+  gate behind ``benchmarks/compare.py`` and bench.py's ``regressions``
+  block.
+
+This package is stdlib-only at import time (no jax import) — safe to
+import from any layer, including before backend selection.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
+from .server import TelemetryServer, checkpoint_check, watchdog_check
 from .tracer import Tracer, configure, get_tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Tracer", "configure", "get_tracer",
+    "TelemetryServer", "watchdog_check", "checkpoint_check",
 ]

@@ -1,0 +1,90 @@
+"""Prometheus text-exposition rendering — the ONE place label/escape rules
+live.
+
+Two surfaces emit exposition text: :meth:`MetricsRegistry.prometheus`
+(every registry instrument) and :meth:`ServeMetrics.prometheus` (registry
+instruments plus derived windowed gauges). Before this module each
+formatted its own lines, so an escape-rule or format fix in one could
+silently drift from the other. Both now call these helpers; the format
+conformance tests (`tests/test_obs_server.py`) pin the contract:
+
+- ``# HELP`` / ``# TYPE`` header lines precede each series, HELP text
+  with backslash/newline escaped per the exposition spec;
+- counters are cumulative and named ``*_total``;
+- histograms emit CUMULATIVE ``_bucket{le="..."}`` series ending with
+  ``le="+Inf"``, plus a ``_sum`` / ``_count`` pair whose ``_count``
+  equals the ``+Inf`` bucket.
+
+Format reference: Prometheus text exposition format 0.0.4 (the lingua
+franca every scraper speaks). Stdlib-only, like the rest of ``obs``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition spec: backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(text: str) -> str:
+    """Label-value escaping: backslash, double-quote, newline."""
+    return (text.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_value(v) -> str:
+    """One numeric formatting rule for every series: ``repr`` keeps ints
+    exact and floats round-trippable (what both emitters always used)."""
+    return repr(v)
+
+
+def render_header(name: str, kind: str, help: str = "") -> List[str]:
+    """``# HELP`` (when non-empty) + ``# TYPE`` lines for one series."""
+    lines = []
+    if help:
+        lines.append(f"# HELP {name} {escape_help(help)}")
+    lines.append(f"# TYPE {name} {kind}")
+    return lines
+
+
+def render_scalar(name: str, kind: str, value, help: str = "") -> List[str]:
+    """A complete single-sample series (counter or gauge)."""
+    return render_header(name, kind, help) + [
+        f"{name} {format_value(value)}"]
+
+
+def render_histogram(name: str, cumulative: Iterable[Tuple[float, int]],
+                     sum_: float, count: int, help: str = "") -> List[str]:
+    """A complete histogram family from ``(upper_bound, cumulative_count)``
+    pairs (the last pair must be the ``+Inf`` bucket — callers hand us
+    :meth:`Histogram.cumulative` output, which guarantees it)."""
+    lines = render_header(name, "histogram", help)
+    for le, cum in cumulative:
+        le_s = "+Inf" if le == float("inf") else repr(le)
+        lines.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+    lines.append(f"{name}_sum {format_value(sum_)}")
+    lines.append(f"{name}_count {count}")
+    return lines
+
+
+def render_instruments(items) -> List[str]:
+    """Exposition lines for ``(name, instrument)`` pairs of the registry's
+    Counter / Gauge / Histogram kinds (import deferred — registry imports
+    this module)."""
+    from .registry import Counter, Gauge, Histogram
+
+    lines: List[str] = []
+    for name, inst in items:
+        if isinstance(inst, Histogram):
+            v = inst.value
+            lines.extend(render_histogram(name, inst.cumulative(),
+                                          v["sum"], v["count"], inst.help))
+        else:
+            kind = "counter" if isinstance(inst, Counter) else "gauge"
+            lines.extend(render_scalar(name, kind, inst.value, inst.help))
+    return lines

@@ -234,26 +234,14 @@ class MetricsRegistry:
         return out
 
     def prometheus(self) -> str:
-        """Prometheus text exposition (format 0.0.4)."""
+        """Prometheus text exposition (format 0.0.4). Rendering lives in
+        :mod:`~dcnn_tpu.obs.exposition` — shared with
+        ``ServeMetrics.prometheus`` so escape/format rules can't drift."""
+        from .exposition import render_instruments
+
         with self._lock:
             items = sorted(self._instruments.items())
-        lines: List[str] = []
-        for name, inst in items:
-            kind = {Counter: "counter", Gauge: "gauge",
-                    Histogram: "histogram"}[type(inst)]
-            if inst.help:
-                lines.append(f"# HELP {name} {inst.help}")
-            lines.append(f"# TYPE {name} {kind}")
-            if isinstance(inst, Histogram):
-                for le, cum in inst.cumulative():
-                    le_s = "+Inf" if le == float("inf") else repr(le)
-                    lines.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
-                v = inst.value
-                lines.append(f"{name}_sum {v['sum']!r}")
-                lines.append(f"{name}_count {v['count']}")
-            else:
-                lines.append(f"{name} {inst.value!r}")
-        return "\n".join(lines) + "\n"
+        return "\n".join(render_instruments(items)) + "\n"
 
     def reset(self) -> None:
         """Zero every instrument and restart the wall clock (tests; a fresh

@@ -1,0 +1,228 @@
+"""Bench-history regression gate over the ``BENCH_r*.json`` trajectory.
+
+Every driver capture appends one ``BENCH_rNN.json`` to the repo root; the
+trajectory (r01 6.7k → r05 26.4k img/s) is the project's performance
+record — and until now nothing read it back, so a perf cliff would ship
+silently. This module compares the newest capture against a trailing
+window of prior captures, per metric, and answers one question: *did we
+just get meaningfully worse at anything we already did better?*
+
+Gate semantics (deliberately asymmetric — improvements always pass):
+
+- per metric, the baseline is the **best** value in the trailing window
+  (max for higher-is-better, min for lower-is-better). Comparing against
+  the best — not the mean — means a regression can't hide behind a weak
+  early capture while the trajectory was still climbing;
+- a regression is a relative move past the metric's ``tolerance``
+  (default 20%): ``newest < best × (1 - tol)`` for higher-is-better,
+  ``newest > best × (1 + tol)`` for lower-is-better;
+- metrics absent from a capture are skipped for that capture (r01 carries
+  only img/s — history grows monotonically richer, the gate never
+  requires retro-fitting old files);
+- a metric may declare a ``guard`` path: only window captures whose guard
+  value equals the newest capture's are comparable (``compile_s`` is
+  guarded on ``phases.compile_cache_hit`` — a cold compile after a warm
+  one is a cache state change, not a compiler regression).
+
+Per-metric tolerances encode measured run-to-run noise: ``h2d_gbps``
+rides the TPU tunnel and has bounced 3x between healthy captures
+(r02 0.033 → r03 0.010 → r04 0.032), so its tolerance is wide; img/s at
+best-of-5-reps is tight.
+
+Consumers: ``benchmarks/compare.py`` (standalone CLI + ``--self-test``
+fixture run, wired into tier-1) and ``bench.py`` (embeds the verdict as a
+``regressions`` block in each new capture, so BENCH_r06+ files carry
+their own gate result).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One gated metric: a dotted ``path`` into the capture's parsed JSON,
+    a direction, and an optional noise tolerance / comparability guard."""
+
+    name: str
+    path: str
+    higher_is_better: bool = True
+    tolerance: Optional[float] = None  # None -> the gate's default
+    guard: Optional[str] = None        # dotted path; must match to compare
+
+
+# The ISSUE-mandated gate set: img/s, MFU, h2d bandwidth, compile wall,
+# int8 serving. Tolerances per the noise notes in the module docstring.
+DEFAULT_METRICS: Sequence[MetricSpec] = (
+    MetricSpec("img_per_sec", "value"),
+    MetricSpec("mfu", "mfu"),
+    MetricSpec("h2d_gbps", "h2d_gbps", tolerance=0.75),
+    MetricSpec("compile_s", "phases.compile_s", higher_is_better=False,
+               tolerance=0.5, guard="phases.compile_cache_hit"),
+    MetricSpec("serve_int8_img_per_sec", "infer_int8_img_per_sec"),
+)
+
+DEFAULT_TOLERANCE = 0.2
+DEFAULT_WINDOW = 4
+
+
+def get_path(d: Any, path: str) -> Optional[Any]:
+    """Resolve a dotted path into nested dicts; None on any miss."""
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def load_capture(path: str) -> Optional[Dict[str, Any]]:
+    """One BENCH file → its parsed-metrics dict, or None when unreadable.
+    Driver captures wrap the bench JSON under ``"parsed"``; a bare bench
+    JSON (a local ``python bench.py > out.json``) is accepted as-is."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    if isinstance(data, dict) and "metric" in data:
+        return data
+    return None
+
+
+def find_bench_files(root: str) -> List[str]:
+    """``BENCH_r*.json`` under ``root``, oldest → newest by capture
+    number (NOT mtime — a re-checkout resets mtimes, numbers don't)."""
+    hits = []
+    for p in _glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _BENCH_RE.search(os.path.basename(p))
+        if m:
+            hits.append((int(m.group(1)), p))
+    return [p for _, p in sorted(hits)]
+
+
+def compare(history: Sequence[Dict[str, Any]], *,
+            metrics: Sequence[MetricSpec] = DEFAULT_METRICS,
+            tolerance: float = DEFAULT_TOLERANCE,
+            window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
+    """Gate the LAST entry of ``history`` against the trailing window of
+    earlier entries. Returns the report dict (see keys below); raises
+    ``ValueError`` on an empty history or nonsensical knobs."""
+    if not history:
+        raise ValueError("empty bench history: nothing to compare")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if not 0 < tolerance < 1:
+        raise ValueError(f"tolerance must be in (0, 1), got {tolerance}")
+    newest, prior = history[-1], list(history[:-1])
+    rows: List[Dict[str, Any]] = []
+    regressions: List[str] = []
+    for spec in metrics:
+        tol = spec.tolerance if spec.tolerance is not None else tolerance
+        cur = get_path(newest, spec.path)
+        row: Dict[str, Any] = {
+            "metric": spec.name, "path": spec.path,
+            "higher_is_better": spec.higher_is_better,
+            "tolerance": tol, "newest": cur,
+        }
+        if not isinstance(cur, (int, float)):
+            row["verdict"] = "skipped: metric absent from newest capture"
+            rows.append(row)
+            continue
+        guard_val = get_path(newest, spec.guard) if spec.guard else None
+        vals: List[float] = []
+        for entry in reversed(prior):  # newest-first until the window fills
+            v = get_path(entry, spec.path)
+            if not isinstance(v, (int, float)):
+                continue
+            if spec.guard and get_path(entry, spec.guard) != guard_val:
+                continue  # different regime (e.g. cache warmth) — not
+                # comparable, and saying so beats a false alarm
+            vals.append(float(v))
+            if len(vals) >= window:
+                break
+        if not vals:
+            row["verdict"] = "skipped: no comparable prior capture"
+            rows.append(row)
+            continue
+        best = max(vals) if spec.higher_is_better else min(vals)
+        ratio = (float(cur) / best) if best else None
+        if spec.higher_is_better:
+            regressed = float(cur) < best * (1.0 - tol)
+        else:
+            regressed = float(cur) > best * (1.0 + tol)
+        row.update({"window": list(reversed(vals)), "best": best,
+                    "ratio": round(ratio, 4) if ratio is not None else None,
+                    "verdict": "REGRESSED" if regressed else "ok"})
+        rows.append(row)
+        if regressed:
+            regressions.append(spec.name)
+    return {"metrics": rows, "regressions": regressions,
+            "ok": not regressions, "window": window,
+            "default_tolerance": tolerance}
+
+
+def compare_files(paths: Sequence[str], **kw) -> Dict[str, Any]:
+    """:func:`compare` over capture FILES (oldest → newest). Unreadable
+    files are reported, never silently dropped."""
+    history, skipped = [], []
+    used = []
+    for p in paths:
+        cap = load_capture(p)
+        if cap is None:
+            skipped.append(p)
+            continue
+        history.append(cap)
+        used.append(p)
+    report = compare(history, **kw)
+    report["files"] = used
+    report["unparseable_files"] = skipped
+    return report
+
+
+def gate_current(current: Dict[str, Any], root: str, **kw
+                 ) -> Optional[Dict[str, Any]]:
+    """Gate an in-flight bench result (``bench.py``'s ``out`` dict)
+    against the ``BENCH_r*.json`` history under ``root``. ``None`` when
+    there is no history (first capture — nothing to regress against);
+    never raises: the gate is a passenger on the bench run, not a way to
+    crash it."""
+    try:
+        files = find_bench_files(root)
+        history = [c for c in (load_capture(p) for p in files)
+                   if c is not None]
+        if not history:
+            return None
+        report = compare(history + [current], **kw)
+        report["baseline_files"] = files
+        return report
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable table for the CLI."""
+    lines = []
+    for row in report["metrics"]:
+        if "best" not in row:
+            lines.append(f"  {row['metric']:<24} {row['verdict']}")
+            continue
+        arrow = "↑" if row["higher_is_better"] else "↓"
+        lines.append(
+            f"  {row['metric']:<24} {arrow} newest {row['newest']:g} "
+            f"vs best-of-{len(row['window'])} {row['best']:g} "
+            f"(ratio {row['ratio']}, tol {row['tolerance']:.0%}) "
+            f"-> {row['verdict']}")
+    verdict = ("OK: no regressions" if report["ok"] else
+               f"REGRESSED: {', '.join(report['regressions'])}")
+    return "\n".join(lines + [verdict])

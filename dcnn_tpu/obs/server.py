@@ -1,0 +1,290 @@
+"""HTTP exposition server: ``/metrics``, ``/healthz``, ``/snapshot``.
+
+PR 3 gave every subsystem one in-process registry and tracer; this module
+is the half that lets anything OUTSIDE the process see them — a Prometheus
+scraper, a load-balancer health probe, or the planned replica router
+(ROADMAP item 2), which will route on exactly the per-replica
+health/latency these endpoints expose.
+
+Endpoints (all GET; anything else is 404/405):
+
+- ``/metrics`` — Prometheus text exposition (format 0.0.4). Default body
+  is ``registry.prometheus()``; a ``metrics_text`` callable overrides it
+  (the serve wiring passes ``ServeMetrics.prometheus`` so the exact
+  windowed percentile gauges ride along).
+- ``/healthz`` — JSON liveness + resilience state. 200 while every
+  registered check passes, **503 the moment one fails**, with a
+  machine-readable body: ``{"status": "unhealthy", "reasons": [...],
+  "checks": {name: {"ok": bool, "reason": ...}}}``. Checks are plain
+  callables returning ``None``/``True`` for healthy or a reason string
+  for degraded (an exception counts as degraded with the exception as
+  the reason — a health check that crashes is not healthy). Adapters for
+  the resilience subsystem live here: :func:`watchdog_check`
+  (``StallWatchdog`` stall state) and :func:`checkpoint_check`
+  (``CheckpointManager.check()`` — failing async saves). The body also
+  carries the registry's guard/resilience flags (``train_stalled``,
+  ``train_skipped_steps_total``, ``ckpt_*``) so a scraper gets the WHY
+  without a second request.
+- ``/snapshot`` — JSON debug dump: the full registry ``snapshot()``, the
+  newest tracer spans (bounded by ``snapshot_events``), per-name span
+  counts, and any extra provider blocks the owner registered (the serve
+  wiring adds the live ``ServeMetrics.snapshot()``).
+
+Design rules, inherited from the rest of ``obs``:
+
+- **stdlib only** (``http.server``) — no framework dependency for three
+  GET routes; ``ThreadingHTTPServer`` so a slow scraper never blocks a
+  health probe.
+- **Injectable everything**: registry, tracer, clock, checks. Tests bind
+  port 0 (ephemeral), drive stall/corruption with fakes, and never sleep.
+- **Read-only**: handlers only ever snapshot/render; no endpoint mutates
+  training or serving state.
+- **Graceful shutdown**: :meth:`TelemetryServer.stop` shuts the listener
+  down, joins the thread, and closes the socket — idempotent, safe from
+  ``finally`` blocks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .exposition import CONTENT_TYPE
+from .registry import MetricsRegistry, get_registry
+from .tracer import Tracer, _json_safe, get_tracer
+
+# registry series mirrored into the /healthz body when present — the
+# resilience flags a router wants alongside the up/down verdict
+_HEALTH_FLAGS = (
+    "train_stalled", "train_last_progress_age_s", "train_stall_flags_total",
+    "train_skipped_steps_total", "train_rollbacks_total",
+    "ckpt_last_step", "ckpt_saves_total", "ckpt_restore_skipped_total",
+)
+
+
+def watchdog_check(watchdog) -> Callable[[], Optional[str]]:
+    """Health check over a :class:`~dcnn_tpu.resilience.guards.StallWatchdog`:
+    degraded while the loop it watches has not beaten within its timeout.
+    Calls ``check()`` live, so the endpoint sees a stall the moment it is
+    scraped — not at the next poll tick."""
+    def _check() -> Optional[str]:
+        if watchdog.check():
+            return (f"stalled: no progress for > "
+                    f"{watchdog.timeout_s:g}s")
+        return None
+    return _check
+
+
+def checkpoint_check(manager) -> Callable[[], Optional[str]]:
+    """Health check over a
+    :class:`~dcnn_tpu.resilience.checkpoint.CheckpointManager`: degraded
+    once an async save has failed — a run whose checkpoints are rotting
+    is not preemption-safe and a router should know before it matters.
+
+    Prefers the manager's NON-consuming, latching ``health()`` probe:
+    ``check()`` is a one-shot that drops inspected futures, so a scrape
+    calling it would steal the failure from the trainer's own
+    per-cadence fail-fast and report healthy again on the next scrape.
+    A fake without ``health()`` falls back to ``check()``."""
+    def _check() -> Optional[str]:
+        probe = getattr(manager, "health", None)
+        try:
+            exc = probe() if probe is not None else manager.check()
+        except Exception as e:
+            exc = e
+        if exc is not None:
+            return f"checkpoint save failing: {type(exc).__name__}: {exc}"
+        return None
+    return _check
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the owning TelemetryServer is attached to the server object
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, default=str).encode("utf-8"),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        owner: "TelemetryServer" = self.server.owner  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, owner.metrics_text().encode("utf-8"),
+                           CONTENT_TYPE)
+            elif path == "/healthz":
+                code, body = owner.health()
+                self._send_json(code, body)
+            elif path == "/snapshot":
+                self._send_json(200, owner.snapshot())
+            else:
+                self._send_json(404, {"error": f"no route {path}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/snapshot"]})
+        except Exception as e:  # a broken provider must not kill the server
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+class TelemetryServer:
+    """Threaded HTTP exposition server over one registry + tracer.
+
+    ``port=0`` binds an ephemeral port (read it back from :attr:`port`
+    after :meth:`start` — the test/e2e pattern); a fixed port is the
+    production scrape target. ``metrics_text`` overrides the ``/metrics``
+    body provider; ``extra_snapshot`` callables contribute named blocks to
+    ``/snapshot``.
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_text: Optional[Callable[[], str]] = None,
+                 snapshot_events: int = 256):
+        if snapshot_events < 0:
+            raise ValueError(
+                f"snapshot_events must be >= 0, got {snapshot_events}")
+        self.registry = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._clock = clock
+        self._host = host
+        self._port = port
+        self.metrics_text = (metrics_text if metrics_text is not None
+                             else self.registry.prometheus)
+        self._snapshot_events = snapshot_events
+        self._checks: List[Tuple[str, Callable[[], Any]]] = []
+        self._extra_snapshot: Dict[str, Callable[[], Any]] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = clock()
+
+    # -- wiring ------------------------------------------------------------
+    def add_check(self, name: str, fn: Callable[[], Any]
+                  ) -> "TelemetryServer":
+        """Register a health check: ``fn()`` returns ``None``/``True`` when
+        healthy, a reason string when degraded; raising counts as degraded.
+        Returns self for chaining."""
+        self._checks.append((name, fn))
+        return self
+
+    def add_snapshot(self, name: str, fn: Callable[[], Any]
+                     ) -> "TelemetryServer":
+        """Register an extra ``/snapshot`` block (``fn()`` must return a
+        JSON-representable value)."""
+        self._extra_snapshot[name] = fn
+        return self
+
+    # -- endpoint bodies (exercised directly by unit tests) ----------------
+    def health(self) -> Tuple[int, Dict[str, Any]]:
+        """(status_code, body) for ``/healthz``: 200 iff every check
+        passes, else 503 with every failing check's machine-readable
+        reason."""
+        checks: Dict[str, Any] = {}
+        reasons: List[str] = []
+        for name, fn in self._checks:
+            try:
+                res = fn()
+            except Exception as e:
+                res = f"{type(e).__name__}: {e}"
+            if res is None or res is True:
+                checks[name] = {"ok": True}
+            else:
+                reason = res if isinstance(res, str) else repr(res)
+                checks[name] = {"ok": False, "reason": reason}
+                reasons.append(f"{name}: {reason}")
+        snap = self.registry.snapshot()
+        flags = {k: snap[k] for k in _HEALTH_FLAGS if k in snap}
+        # the stall gauge doubles as a registry-only degradation signal for
+        # processes that wired a watchdog to the registry but not to us
+        if not any(n == "watchdog" for n, _ in self._checks):
+            if flags.get("train_stalled"):
+                reasons.append("train_stalled: registry flag set")
+        ok = not reasons
+        body = {
+            "status": "ok" if ok else "unhealthy",
+            "reasons": reasons,
+            "checks": checks,
+            "flags": flags,
+            "uptime_s": round(max(self._clock() - self._t0, 0.0), 3),
+        }
+        return (200 if ok else 503), body
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Body for ``/snapshot``: registry dump + newest tracer spans."""
+        events = self.tracer.events()[-self._snapshot_events:] \
+            if self._snapshot_events else []
+        for ev in events:  # tracer attrs may hold arbitrary objects
+            ev["args"] = {k: _json_safe(v) for k, v in ev["args"].items()}
+        out: Dict[str, Any] = {
+            "metrics": self.registry.snapshot(),
+            "spans": events,
+            "span_counts": self.tracer.span_counts(),
+            "tracer_enabled": self.tracer.enabled,
+        }
+        for name, fn in self._extra_snapshot.items():
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        httpd.daemon_threads = True
+        httpd.owner = self  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._port = httpd.server_address[1]  # resolve an ephemeral bind
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"dcnn-telemetry-{self._port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful, idempotent shutdown: stop serving, join, close."""
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def __repr__(self) -> str:
+        state = "listening" if self._httpd is not None else "stopped"
+        return (f"TelemetryServer({self.url}, {state}, "
+                f"checks={[n for n, _ in self._checks]})")

@@ -43,6 +43,7 @@ honesty line the rest of the repo draws (core/fence.py).
 
 from __future__ import annotations
 
+import gzip as _gzip
 import json
 import os
 import threading
@@ -239,23 +240,84 @@ class Tracer:
         return counts
 
     # -- exporters ---------------------------------------------------------
-    def export_jsonl(self, path: str) -> str:
-        """One JSON object per line per event."""
+    def _write_jsonl(self, evs: list, path: str, gzip: bool) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            for ev in self.events():
-                f.write(json.dumps(ev) + "\n")
+        opener = (lambda p: _gzip.open(p, "wt")) if gzip else \
+            (lambda p: open(p, "w"))
+        with opener(path) as f:
+            for (n, ts, dur, track, attrs) in evs:
+                f.write(json.dumps({"name": n, "ts_s": ts, "dur_s": dur,
+                                    "track": track,
+                                    "args": dict(attrs)}) + "\n")
+
+    def export_jsonl(self, path: str, *, gzip: bool = False) -> str:
+        """One JSON object per line per event. ``gzip=True`` writes the
+        stream gzip-compressed (span JSONL compresses ~10x — the names and
+        tracks repeat every line)."""
+        self._write_jsonl(self._events_list(), path, gzip)
         return path
 
-    def export_chrome(self, path: str) -> str:
+    def flush_jsonl(self, path: str, *, gzip: bool = False) -> str:
+        """Export, then drop EXACTLY the exported events — the
+        periodic-drain entry point for long soaks: flush the ring to disk
+        before eviction loses the oldest events, keep recording.
+
+        Concurrency contract: events recorded while the file is being
+        written are NOT lost — only events from the snapshot that reached
+        disk are popped (checked by identity, so a saturated ring that
+        evicted already-exported events during the write never makes the
+        drain over-pop unexported ones), and concurrent appends land on
+        the other end, so they ride the next flush. A failed write drops
+        nothing. The tracer epoch is untouched, so timestamps stay
+        monotone across flushes and spans straddling a flush stay valid
+        (``clear()``, by contrast, restarts the timeline)."""
+        evs = self._events_list()
+        self._write_jsonl(evs, path, gzip)
+        exported = set(map(id, evs))  # attrs dicts make tuples unhashable
+        for _ in range(len(evs)):
+            try:
+                head = self._events.popleft()
+            except IndexError:  # eviction raced us: already gone
+                break
+            if id(head) not in exported:
+                # eviction consumed the rest of the exported prefix while
+                # we drained; this event is newer than the snapshot — put
+                # it back and stop (ring just shed one slot, so the
+                # appendleft cannot evict)
+                self._events.appendleft(head)
+                break
+        return path
+
+    def export_chrome(self, path: str, *,
+                      max_events: Optional[int] = None) -> str:
         """Chrome ``trace_event`` JSON (Perfetto / chrome://tracing).
 
         Complete spans become ``ph:"X"`` events (µs timestamps); instants
         become ``ph:"i"``. Each distinct track maps to a stable tid
         (first-seen order) with a ``thread_name`` metadata record, so the
         viewer shows labeled rows — "stage0", "h2d-xfer_0", "serve" — not
-        anonymous thread ids."""
+        anonymous thread ids.
+
+        ``max_events`` caps the exported event count (viewers choke on
+        multi-million-event files): the NEWEST ``max_events`` survive and
+        the drop is explicit, never silent — a ``tracer.truncated`` instant
+        at the head of the trace (on a ``tracer`` track) says exactly how
+        many older events were cut, log-truncation style."""
         evs = self._events_list()
+        truncated = 0
+        if max_events is not None:
+            if max_events < 1:
+                raise ValueError(
+                    f"max_events must be >= 1, got {max_events}")
+            if len(evs) > max_events:
+                truncated = len(evs) - max_events
+                evs = evs[-max_events:]
+                # an explicit head-of-trace note, stamped just before the
+                # oldest surviving event so it sorts first in the viewer
+                evs = [("tracer.truncated", evs[0][1], None, "tracer",
+                        {"dropped_older_events": truncated,
+                         "note": f"... {truncated} older events truncated "
+                                 f"(max_events={max_events})"})] + evs
         tids: Dict[str, int] = {}
         out: List[Dict[str, Any]] = [{
             "ph": "M", "pid": 1, "tid": 0, "name": "process_name",

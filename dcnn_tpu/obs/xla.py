@@ -1,0 +1,174 @@
+"""Compiled-executable introspection: XLA cost analysis, compile-event
+accounting, HBM watermarks.
+
+The headline MFU has so far been computed from the model's own
+``forward_complexity() × 3`` analytic formula — an estimate of what the
+model *should* cost, not what the compiled program *does* cost. XLA knows
+the truth: every compiled executable carries a cost analysis (FLOPs and
+bytes accessed, post-fusion/post-layout) and the runtime exposes per-device
+HBM occupancy. This module is the thin, version-tolerant shim between
+those APIs and the obs registry:
+
+- :func:`executable_cost` / :func:`jit_cost` — normalized
+  ``{flops, bytes_accessed, bytes_per_flop}`` from
+  ``lowered.compile().cost_analysis()`` (which returns a list-of-dicts on
+  some jax versions, a dict on others, and nothing on some backends —
+  callers always see one dict or ``None``, never a version branch).
+  ``bytes_per_flop`` is the roofline coordinate: against a chip's
+  ``HBM GB/s ÷ peak FLOP/s`` ridge it says whether an executable is
+  compute- or bandwidth-bound.
+- :func:`record_compile` — the ``compile_total`` /
+  ``compile_seconds_total`` counters every compile site feeds (bench's
+  headline step, the serve engine's per-bucket sessions), so the 149.9 s
+  compile wall (ROADMAP item 4) is a scrapeable series, not a one-off
+  bench field.
+- :func:`sample_hbm` — HBM gauges from ``jax.Device.memory_stats()``
+  (the ``utils/hardware.py`` path): ``hbm_bytes_in_use`` /
+  ``hbm_bytes_limit`` summed over devices plus a monotone
+  ``hbm_peak_bytes`` watermark. Cheap to call on epoch/dispatch
+  boundaries; on backends without memory stats (CPU) the first failed
+  probe latches and every later call is a no-op.
+
+jax is imported lazily inside each function — the ``obs`` package stays
+importable before backend selection, as its package docstring promises.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+# tri-state memory_stats support latch: None = unprobed, True/False after
+# the first attempt — keeps per-dispatch sampling free on CPU backends
+_HBM_SUPPORTED: Optional[bool] = None
+
+
+def executable_cost(compiled: Any) -> Optional[Dict[str, float]]:
+    """Normalized cost analysis of a compiled executable (the object
+    ``jitted.lower(...).compile()`` returns). ``None`` when the backend
+    exposes no analysis — callers must treat cost as optional."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    # jax has returned list-of-dicts (one per partition), a bare dict, and
+    # None across versions; take the first partition's properties
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    by = ca.get("bytes accessed")
+    if flops is None and by is None:
+        return None
+    out: Dict[str, float] = {}
+    if flops is not None and flops > 0:
+        out["flops"] = float(flops)
+    if by is not None and by > 0:
+        out["bytes_accessed"] = float(by)
+    if "flops" in out and "bytes_accessed" in out:
+        out["bytes_per_flop"] = out["bytes_accessed"] / out["flops"]
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["temp_bytes"] = float(mem.temp_size_in_bytes)
+            out["argument_bytes"] = float(mem.argument_size_in_bytes)
+            out["output_bytes"] = float(mem.output_size_in_bytes)
+    except Exception:
+        pass  # memory analysis is a bonus, never a requirement
+    return out or None
+
+
+def jit_cost(jitted: Any, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """Cost analysis of ``jitted`` at the avals of ``args``/``kwargs``
+    (concrete arrays or ``jax.ShapeDtypeStruct`` specs — lowering never
+    executes). With the persistent compile cache on, the ``.compile()``
+    here is served from cache when the caller already compiled these
+    shapes; on any failure (backend without lowering introspection, aval
+    mismatch) the answer is ``None``, not an exception — cost telemetry
+    must never break the measurement it describes."""
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    return executable_cost(compiled)
+
+
+def record_compile(seconds: float, *, what: str = "",
+                   registry: Optional[MetricsRegistry] = None) -> None:
+    """Count one compile event: ``compile_total`` += 1,
+    ``compile_seconds_total`` += ``seconds`` (and, when ``what`` is given,
+    the per-site ``compile_<what>_seconds_total`` twin). The registry pair
+    is the rate-able series the AOT-cache work (ROADMAP item 4) will be
+    judged against."""
+    reg = registry if registry is not None else get_registry()
+    reg.counter("compile_total", "XLA executables compiled").inc()
+    reg.counter("compile_seconds_total",
+                "wall seconds spent compiling").inc(max(seconds, 0.0))
+    if what:
+        reg.counter(f"compile_{what}_seconds_total",
+                    f"wall seconds compiling {what} executables").inc(
+            max(seconds, 0.0))
+
+
+def analytic_mfu(flops_per_sample: Optional[float],
+                 samples_per_sec: Optional[float],
+                 peak_tflops: Optional[float]) -> Optional[float]:
+    """MFU from measured executable FLOPs: achieved FLOP/s over the chip
+    peak. ``None`` whenever an input is unknown (no cost analysis, no
+    known peak) — absent beats fabricated."""
+    if not flops_per_sample or not samples_per_sec or not peak_tflops:
+        return None
+    return (flops_per_sample * samples_per_sec) / (peak_tflops * 1e12)
+
+
+def sample_hbm(registry: Optional[MetricsRegistry] = None,
+               devices=None) -> Optional[Dict[str, float]]:
+    """Sample device memory into HBM gauges; returns the sample dict or
+    ``None`` when the backend has no memory stats.
+
+    - ``hbm_bytes_in_use`` / ``hbm_bytes_limit``: summed over devices
+      (the fleet-level occupancy a scraper plots);
+    - ``hbm_peak_bytes``: monotone high-water mark — the max per-device
+      ``peak_bytes_in_use`` seen by ANY sample this process (falls back
+      to tracking max ``bytes_in_use`` when the runtime reports no peak).
+    """
+    global _HBM_SUPPORTED
+    if _HBM_SUPPORTED is False:
+        return None
+    try:
+        import jax
+
+        devs = devices if devices is not None else jax.devices()
+        in_use = limit = 0.0
+        peak = 0.0
+        got = False
+        for d in devs:
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            got = True
+            in_use += float(stats.get("bytes_in_use") or 0)
+            limit += float(stats.get("bytes_limit") or 0)
+            peak = max(peak, float(stats.get("peak_bytes_in_use")
+                                   or stats.get("bytes_in_use") or 0))
+        if not got:
+            _HBM_SUPPORTED = False
+            return None
+    except Exception:
+        _HBM_SUPPORTED = False
+        return None
+    _HBM_SUPPORTED = True
+    reg = registry if registry is not None else get_registry()
+    reg.gauge("hbm_bytes_in_use",
+              "device memory in use, summed over devices").set(in_use)
+    if limit:
+        reg.gauge("hbm_bytes_limit",
+                  "device memory capacity, summed over devices").set(limit)
+    g = reg.gauge("hbm_peak_bytes",
+                  "high-water per-device memory this process")
+    if peak > g.value:
+        g.set(peak)
+    return {"hbm_bytes_in_use": in_use, "hbm_bytes_limit": limit or None,
+            "hbm_peak_bytes": max(peak, g.value)}

@@ -183,6 +183,7 @@ class CheckpointManager:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._pending: list = []  # async-save futures not yet inspected
+        self._last_failure: Optional[BaseException] = None  # health() latch
 
     # -- serialization (format owned by train/checkpoint.py) --
     @staticmethod
@@ -334,7 +335,25 @@ class CheckpointManager:
                 first_exc = exc
         self._pending = still_pending
         if first_exc is not None:
+            self._last_failure = first_exc
             raise first_exc
+
+    def health(self) -> Optional[BaseException]:
+        """NON-consuming failure probe for health endpoints: the first
+        known save failure (latched — once a save has failed this manager
+        reports unhealthy until the process decides otherwise), or
+        ``None``. Unlike :meth:`check` it never drops pending futures and
+        never raises, so a ``/healthz`` scrape can poll it at any cadence
+        WITHOUT disarming the trainer's own per-cadence ``check()``
+        fail-fast (obs/server.py ``checkpoint_check`` uses this)."""
+        if self._last_failure is None:
+            for f in self._pending:
+                if f.done():
+                    exc = f.exception()
+                    if exc is not None:
+                        self._last_failure = exc
+                        break
+        return self._last_failure
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until every queued async save has committed. Re-raises the

@@ -15,7 +15,7 @@ defense is split the way jit demands:
   to host) and the guard decides what the flag *means*:
 
   - ``"raise"`` — abort with :class:`NonFiniteError` naming the step;
-  - ``"skip_step"`` — count it (``train_skipped_steps`` on the obs
+  - ``"skip_step"`` — count it (``train_skipped_steps_total`` on the obs
     registry) and keep going: params/opt_state were never touched;
   - ``"rollback"`` — like skip, until ``rollback_after`` *consecutive*
     bad steps, then tell the Trainer to restore the last checkpoint
@@ -95,7 +95,7 @@ class StepGuard:
             raise NonFiniteError(step, loss)
         self.consecutive_bad += 1
         self.total_skipped += 1
-        self._reg.counter("train_skipped_steps",
+        self._reg.counter("train_skipped_steps_total",
                           "train steps skipped by the non-finite guard").inc()
         warnings.warn(
             f"non-finite loss/grad at step {step}: step skipped "

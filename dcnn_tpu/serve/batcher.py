@@ -42,6 +42,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..obs import get_tracer
+from ..obs.xla import sample_hbm
 from .engine import InferenceEngine
 from .metrics import ServeMetrics
 
@@ -103,6 +104,9 @@ class DynamicBatcher:
         self._cond = threading.Condition()
         self._closing = False
         self._thread: Optional[threading.Thread] = None
+        self._telemetry = None  # TelemetryServer from start_telemetry()
+        self._compile_mirrored = False  # engine compile counters copied
+        # onto the scrape registry at most once
         if start:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True,
@@ -155,6 +159,72 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         with self._cond:
             return self._rows
+
+    # -- telemetry (the per-replica scrape surface a router reads) ---------
+    def health_reason(self) -> Optional[str]:
+        """``None`` while this batcher can accept traffic; otherwise the
+        machine-readable reason it can't. This is the ``/healthz``
+        contract for the planned replica router (ROADMAP item 2): a
+        draining or dead replica must fail health BEFORE requests error,
+        so the router stops routing to it."""
+        if self._closing:
+            return "draining or shut down: not accepting requests"
+        if self._thread is not None and not self._thread.is_alive():
+            return "dispatcher thread dead"
+        return None
+
+    def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """Expose THIS batcher over HTTP
+        (:class:`~dcnn_tpu.obs.server.TelemetryServer`): ``/metrics`` is
+        ``ServeMetrics.prometheus()`` (registry instruments + exact
+        windowed percentile gauges), ``/healthz`` follows
+        :meth:`health_reason`, ``/snapshot`` adds the live serve snapshot
+        and engine compile/cost stats. ``port=0`` binds an ephemeral port
+        (read ``.port`` back). The server survives :meth:`drain` — final
+        stats stay scrapeable, with ``/healthz`` already 503 — and stops
+        at :meth:`shutdown`. Calling it again replaces the previous
+        server (stopped first — never a leaked bound port). Returns the
+        started server."""
+        from ..obs.server import TelemetryServer
+
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
+        srv = TelemetryServer(registry=self.metrics.registry,
+                              metrics_text=self.metrics.prometheus,
+                              host=host, port=port)
+        # mirror the engine's per-sample cost gauges, HBM watermark, and
+        # per-bucket compile accounting onto THIS scrape registry:
+        # ServeMetrics' default registry is private, and the startup
+        # allocation spike / roofline / compile-wall numbers must appear
+        # on the surface the router actually reads. Counters are bumped
+        # once (flag-guarded — a second start_telemetry must not
+        # double-count).
+        reg = self.metrics.registry
+        self.engine._export_cost_gauges(reg)
+        sample_hbm(reg)
+        if reg is not self.engine.registry and not self._compile_mirrored:
+            self._compile_mirrored = True
+            secs = sum(st.get("compile_s", 0.0)
+                       for st in self.engine.compile_stats.values())
+            reg.counter("compile_total",
+                        "XLA executables compiled").inc(
+                len(self.engine.compile_stats))
+            reg.counter("compile_seconds_total",
+                        "wall seconds spent compiling").inc(secs)
+            reg.counter("compile_serve_seconds_total",
+                        "wall seconds compiling serve executables").inc(
+                secs)
+        srv.add_check("batcher", self.health_reason)
+        srv.add_snapshot("serve", self.metrics.snapshot)
+        srv.add_snapshot("engine", lambda: {
+            "name": self.engine.name,
+            "buckets": self.engine.bucket_sizes,
+            "batch_invariant": self.engine.batch_invariant,
+            "compile_stats": self.engine.compile_stats,
+        })
+        self._telemetry = srv.start()
+        return srv
 
     # -- dispatch core (shared by the thread and the synchronous step) --
     def _pop_due(self, force: bool) -> List[_Request]:
@@ -214,6 +284,9 @@ class DynamicBatcher:
                     pass  # failed by a timed-out drain racing this dispatch
                 off += r.n
             self.metrics.record_batch(rows, padded.shape[0])
+            # dispatch-boundary HBM watermark (obs/xla): latched no-op on
+            # backends without memory stats, so the hot path stays clean
+            sample_hbm(self.metrics.registry)
         except Exception as e:  # scatter the failure, don't kill the thread
             for r in batch:
                 if not r.future.done():
@@ -312,7 +385,14 @@ class DynamicBatcher:
         :class:`ShutdownError` (a request someone is blocked on must
         resolve, not vanish with the batcher)."""
         if drain:
-            self.drain(timeout)
+            try:
+                self.drain(timeout)
+            finally:
+                # even an expired drain (TimeoutError) must release the
+                # scrape port — a leaked server blocks the replica restart
+                if self._telemetry is not None:
+                    self._telemetry.stop()
+                    self._telemetry = None
             return
         exc = ShutdownError("batcher shut down without drain")
         with self._cond:
@@ -337,6 +417,9 @@ class DynamicBatcher:
             self._thread.join(timeout)
             self._thread = None
         self._fail_pending(exc)  # sweep any remainder: no future orphaned
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
 
     def __enter__(self) -> "DynamicBatcher":
         return self

@@ -47,7 +47,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..obs import get_tracer
+from ..obs import get_registry, get_tracer
+from ..obs.xla import executable_cost, record_compile, sample_hbm
 
 
 def serve_buckets(max_batch: int) -> List[int]:
@@ -78,8 +79,14 @@ class InferenceEngine:
     def __init__(self, apply_fn: Callable, input_shape: Sequence[int], *,
                  max_batch: int = 32, input_dtype: Any = jnp.float32,
                  donate: Optional[bool] = None, warmup: bool = True,
-                 batch_invariant: bool = False, name: str = "engine"):
+                 batch_invariant: bool = False, name: str = "engine",
+                 registry=None):
         self.name = name
+        # cost/HBM gauges land here (default: the process-global registry);
+        # a batcher's start_telemetry additionally mirrors them onto its
+        # own scrape registry so a private-registry replica still exposes
+        # them on /metrics
+        self.registry = registry if registry is not None else get_registry()
         self.input_shape = tuple(int(d) for d in input_shape)
         self.input_dtype = jnp.dtype(input_dtype)
         self.bucket_sizes = serve_buckets(max_batch)
@@ -100,6 +107,7 @@ class InferenceEngine:
                              engine=name, bucket=b):
                 session = jitted.lower(spec).compile()
             compile_s = time.perf_counter() - t0
+            record_compile(compile_s, what="serve", registry=self.registry)
             t0 = time.perf_counter()
             if warmup:
                 with tracer.span("serve.warmup", track="serve",
@@ -110,6 +118,35 @@ class InferenceEngine:
             self.compile_stats[b] = {
                 "compile_s": round(compile_s, 4),
                 "warmup_s": round(time.perf_counter() - t0, 4)}
+            # XLA's own accounting for this bucket's executable (obs/xla):
+            # FLOPs + bytes-accessed feed the serve roofline and the
+            # analytic per-sample cost the bench/router read
+            cost = executable_cost(session)
+            if cost is not None:
+                self.compile_stats[b].update(
+                    {k: cost[k] for k in ("flops", "bytes_accessed",
+                                          "bytes_per_flop") if k in cost})
+        self._export_cost_gauges(self.registry)
+        # post-compile HBM watermark: engine startup is the serve-side
+        # allocation spike (every bucket's weights + workspace); no-op on
+        # backends without memory stats
+        sample_hbm(self.registry)
+
+    def _export_cost_gauges(self, registry) -> None:
+        """Set the per-sample XLA cost gauges on ``registry`` (engine
+        startup does it for :attr:`registry`; ``start_telemetry`` repeats
+        it for the batcher's scrape registry)."""
+        top = self.compile_stats.get(self.max_batch, {})
+        if top.get("flops"):
+            registry.gauge(
+                "serve_flops_per_sample",
+                "XLA cost-analysis FLOPs per sample at the largest "
+                "serve bucket").set(top["flops"] / self.max_batch)
+            if top.get("bytes_per_flop") is not None:
+                registry.gauge(
+                    "serve_bytes_per_flop",
+                    "roofline byte/FLOP ratio of the largest serve "
+                    "bucket executable").set(top["bytes_per_flop"])
 
     # -- constructors --
     @classmethod

@@ -184,6 +184,8 @@ class ServeMetrics:
         queue-depth gauge, latency histogram) plus the exact windowed
         percentiles/occupancy appended as gauges (they are derived views
         over the rolling window, not registry instruments)."""
+        from ..obs.exposition import render_scalar
+
         s = self.snapshot()
         lines = [self.registry.prometheus().rstrip("\n")]
         derived = {
@@ -198,8 +200,7 @@ class ServeMetrics:
         for name, v in derived.items():
             if v is None:
                 continue  # absent series, not a lying 0.0
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {v!r}")
+            lines.extend(render_scalar(name, "gauge", v))
         return "\n".join(lines) + "\n"
 
     def __repr__(self) -> str:

@@ -293,6 +293,7 @@ class Trainer:
         else:
             self.checkpoints = None
         self.watchdog = None  # created per fit() when stall_timeout_s > 0
+        self.telemetry = None  # TelemetryServer, per fit() (metrics_port)
         self._global_step = 0
         self.train_step = make_train_step(model, self.loss_fn, optimizer,
                                           self.config.num_microbatches,
@@ -566,9 +567,31 @@ class Trainer:
             from ..resilience.guards import StallWatchdog
             self.watchdog = StallWatchdog(cfg.stall_timeout_s).start()
         try:
+            if cfg.metrics_port >= 0:
+                # external telemetry plane (obs/server.py): /metrics
+                # scrape + /healthz (watchdog stall and rotting-checkpoint
+                # states flip it to 503) + /snapshot, live for the whole
+                # fit. Inside the try: a failed bind (port in use) must
+                # still stop the watchdog below
+                from ..obs import (TelemetryServer, checkpoint_check,
+                                   watchdog_check)
+                srv = TelemetryServer(registry=reg, tracer=tracer,
+                                      port=cfg.metrics_port)
+                if self.watchdog is not None:
+                    srv.add_check("watchdog",
+                                  watchdog_check(self.watchdog))
+                if self.checkpoints is not None:
+                    srv.add_check("checkpoint",
+                                  checkpoint_check(self.checkpoints))
+                self.telemetry = srv.start()
+                print(f"telemetry: {srv.url}/metrics /healthz /snapshot",
+                      flush=True)
             return self._fit_loop(ts, train_loader, val_loader, epochs,
                                   start_epoch, rng, best_val, tracer, reg)
         finally:
+            if self.telemetry is not None:
+                self.telemetry.stop()
+                self.telemetry = None
             if self.watchdog is not None:
                 self.watchdog.stop()
                 self.watchdog = None
@@ -602,6 +625,10 @@ class Trainer:
                           "last epoch samples/sec").set(n_epoch / dt)
             reg.histogram("train_epoch_seconds",
                           "wall per epoch").observe(dt)
+            # epoch-boundary HBM watermark (obs/xla): a latched no-op on
+            # backends without memory stats (CPU), gauges + peak elsewhere
+            from ..obs.xla import sample_hbm
+            sample_hbm(reg)
             reg.gauge("train_lr", "current learning rate").set(
                 float(self.lr))
             reg.gauge("train_loss", "last epoch mean train loss").set(

@@ -14,7 +14,15 @@ completions — the honest way to measure an overloaded server: a closed
 loop self-throttles and hides the queue growth that shedding exists for).
 
 Usage:
-    python examples/serve_snapshot.py [snapshot_dir]
+    python examples/serve_snapshot.py [snapshot_dir] [--metrics-port N]
+
+``--metrics-port N`` (or env ``METRICS_PORT``) additionally exposes the
+live telemetry plane over HTTP for the whole run — ``/metrics``
+(Prometheus text from the process-global registry the per-point
+``ServeMetrics`` instances pool into), ``/healthz``, ``/snapshot`` — the
+same per-replica scrape surface the future router tier reads
+(docs/observability.md "External scraping"). ``N=0`` picks an ephemeral
+port and prints it.
 
 Env knobs: ``INT8=1`` serves the int8 PTQ graph (calibrated on the train
 split — never the measured one); ``SERVE_LOADS`` comma-separated offered
@@ -26,8 +34,8 @@ batching window (default 2.0), ``SERVE_QUEUE`` queue capacity in samples
 
 from __future__ import annotations
 
+import argparse
 import os
-import sys
 import time
 
 from common import setup
@@ -46,8 +54,15 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main():
     setup("serve_snapshot")
-    snap = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        ROOT, "model_snapshots", "mnist_cnn_model")
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot_dir", nargs="?", default=os.path.join(
+        ROOT, "model_snapshots", "mnist_cnn_model"))
+    ap.add_argument("--metrics-port", type=int,
+                    default=int(os.environ.get("METRICS_PORT", "-1")),
+                    help="expose /metrics /healthz /snapshot over HTTP "
+                         "(0 = ephemeral; default off)")
+    args = ap.parse_args()
+    snap = args.snapshot_dir
 
     import accuracy_gates
     csv_dir = accuracy_gates.ensure_digits28_csvs()
@@ -90,6 +105,18 @@ def main():
              os.environ.get("SERVE_LOADS", "100,300,900").split(",")]
     seconds = float(os.environ.get("SERVE_SECONDS", "2.0"))
 
+    telemetry = None
+    if args.metrics_port >= 0:
+        # one scrape surface for the whole run: per-point ServeMetrics pool
+        # their instruments into the process-global registry (cumulative
+        # counters — constructing a new point never resets them), while the
+        # printed table keeps its exact per-point snapshots
+        from dcnn_tpu.obs import TelemetryServer, get_registry
+
+        telemetry = TelemetryServer(registry=get_registry(),
+                                    port=args.metrics_port).start()
+        print(f"telemetry: {telemetry.url}/metrics /healthz /snapshot")
+
     print(f"\nopen-loop traffic: {seconds:.1f}s per point, max_wait "
           f"{wait_ms:g} ms, queue {qcap} samples "
           f"({'int8' if int8 else 'folded float'} graph)")
@@ -99,7 +126,11 @@ def main():
     print(hdr)
     print("-" * len(hdr))
     for rps in loads:
-        metrics = ServeMetrics()
+        if telemetry is not None:
+            from dcnn_tpu.obs import get_registry
+            metrics = ServeMetrics(registry=get_registry())
+        else:
+            metrics = ServeMetrics()
         batcher = DynamicBatcher(engine, max_wait_ms=wait_ms,
                                  queue_capacity=qcap, metrics=metrics)
         futs = run_open_loop(batcher, samples, rps, seconds)
@@ -115,6 +146,8 @@ def main():
         if acc == acc and acc < 0.98:  # batching must not change answers
             raise SystemExit(f"served accuracy {acc} below gate at "
                              f"{rps} rps")
+    if telemetry is not None:
+        telemetry.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,694 @@
+"""Telemetry-plane tests: HTTP exposition server, shared Prometheus
+rendering, XLA cost/HBM introspection, tracer flush/truncation.
+
+Contracts:
+
+- **exposition conformance** (one shared renderer — serve/metrics.py and
+  obs/registry.py may not drift): HELP/TYPE header lines, counters named
+  ``*_total``, histograms with CUMULATIVE ``le`` buckets ending in
+  ``+Inf`` and a ``_sum``/``_count`` pair whose count equals the ``+Inf``
+  bucket;
+- **TelemetryServer**: a live process exposes ``/metrics`` (valid
+  Prometheus text), ``/healthz`` (200 healthy / **503 with a
+  machine-readable reason** on watchdog-stall and corrupt-checkpoint
+  states — injectable fakes, no sleeps) and ``/snapshot`` over HTTP on an
+  ephemeral port, end to end via real GETs; graceful + idempotent stop;
+- **wiring**: ``DynamicBatcher.start_telemetry`` serves the per-replica
+  scrape surface and flips 503 on drain (the router contract); a live
+  ``Trainer.fit`` with ``metrics_port=0`` scrapes mid-epoch;
+- **obs/xla**: normalized cost analysis of real compiled executables
+  (flops/bytes/roofline ratio), compile counters, HBM sampling latch;
+- **tracer satellites**: ``flush_jsonl`` (plain + gzip, buffer cleared
+  only after the write) and ``export_chrome(max_events=)`` with an
+  explicit truncation note — never a silent drop.
+"""
+
+import gzip
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from dcnn_tpu.obs import MetricsRegistry, TelemetryServer
+from dcnn_tpu.obs.exposition import CONTENT_TYPE
+from dcnn_tpu.obs.server import checkpoint_check, watchdog_check
+from dcnn_tpu.obs.tracer import Tracer
+from dcnn_tpu.obs import xla as obs_xla
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _get(url, timeout=10):
+    """(status, headers, body_bytes) for a GET, 4xx/5xx included."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+# ------------------------------------------------ exposition conformance
+
+def assert_exposition_conformant(text: str):
+    """The format rules every scraper assumes, checked line by line."""
+    lines = [l for l in text.splitlines() if l]
+    types = {}   # series name -> declared type
+    helped = set()
+    samples = {}  # name -> value str (scalar series)
+    buckets = {}  # hist name -> list[(le_str, cum_int)]
+    for line in lines:
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in types, f"HELP after TYPE for {name}"
+            helped.add(name)
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram")
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+        else:
+            name, _, value = line.partition(" ")
+            if "{" in name:
+                base, _, rest = name.partition("{")
+                assert base.endswith("_bucket"), name
+                assert rest.startswith('le="') and rest.endswith('"}'), name
+                buckets.setdefault(base[: -len("_bucket")], []).append(
+                    (rest[4:-2], int(value)))
+            else:
+                float(value)  # every sample parses as a number
+                samples[name] = value
+    for name, kind in types.items():
+        if kind == "counter":
+            assert name.endswith("_total"), \
+                f"counter {name} missing _total suffix"
+            assert name in samples
+        elif kind == "histogram":
+            cums = buckets.get(name)
+            assert cums, f"histogram {name} has no _bucket series"
+            assert cums[-1][0] == "+Inf", f"{name} buckets must end at +Inf"
+            counts = [c for _, c in cums]
+            assert counts == sorted(counts), f"{name} buckets not cumulative"
+            assert f"{name}_sum" in samples and f"{name}_count" in samples
+            assert int(samples[f"{name}_count"]) == cums[-1][1], \
+                f"{name}_count != +Inf bucket"
+    return types, samples
+
+
+def test_registry_exposition_conformant():
+    r = MetricsRegistry()
+    r.counter("reqs_total", "requests\nserved").inc(5)
+    r.gauge("depth", "queue depth").set(3)
+    h = r.histogram("lat_seconds", "latency")
+    for v in (1e-5, 2e-3, 0.7, 1e9):  # incl. the +Inf overflow bucket
+        h.observe(v)
+    types, samples = assert_exposition_conformant(r.prometheus())
+    assert types == {"reqs_total": "counter", "depth": "gauge",
+                     "lat_seconds": "histogram"}
+    # HELP newline escaped per the exposition spec, never a raw newline
+    assert "# HELP reqs_total requests\\nserved" in r.prometheus()
+
+
+def test_serve_metrics_exposition_conformant_and_shared():
+    from dcnn_tpu.serve import ServeMetrics
+
+    fc = FakeClock()
+    m = ServeMetrics(clock=fc)
+    m.record_submit(4)
+    m.record_queue_depth(4)
+    m.record_batch(4, 8)
+    fc.advance(0.25)
+    m.record_done(0.25, 4)
+    text = m.prometheus()
+    types, samples = assert_exposition_conformant(text)
+    # derived windowed gauges carry TYPE headers through the SAME renderer
+    assert types["serve_latency_window_p99_ms"] == "gauge"
+    assert samples["serve_samples_completed_total"] == "4"
+    assert types["serve_latency_seconds"] == "histogram"
+
+
+def test_builtin_guard_counter_name_conforms():
+    # the StepGuard skip counter is part of the /healthz flag contract —
+    # its name must carry the counter suffix
+    from dcnn_tpu.resilience.guards import StepGuard
+
+    reg = MetricsRegistry()
+    g = StepGuard("skip_step", registry=reg)
+    with pytest.warns(UserWarning):
+        assert g.observe(1, True) == "skipped"
+    assert reg.counter("train_skipped_steps_total").value == 1
+    assert_exposition_conformant(reg.prometheus())
+
+
+# ------------------------------------------------------- TelemetryServer
+
+def test_server_end_to_end_ephemeral_port():
+    reg = MetricsRegistry()
+    reg.counter("pings_total", "pings").inc(2)
+    tr = Tracer(enabled=True)
+    with tr.span("unit.op", track="t", k=1):
+        pass
+    srv = TelemetryServer(registry=reg, tracer=tr, port=0).start()
+    try:
+        assert srv.port > 0
+        code, hdrs, body = _get(srv.url + "/metrics")
+        assert code == 200 and hdrs["Content-Type"] == CONTENT_TYPE
+        assert_exposition_conformant(body.decode())
+        assert "pings_total 2" in body.decode()
+
+        code, _, body = _get(srv.url + "/healthz")
+        h = json.loads(body)
+        assert code == 200 and h["status"] == "ok" and h["reasons"] == []
+
+        code, _, body = _get(srv.url + "/snapshot")
+        s = json.loads(body)
+        assert code == 200
+        assert s["metrics"]["pings_total"] == 2
+        assert s["span_counts"] == {"unit.op": 1}
+        assert s["spans"][0]["name"] == "unit.op"
+        assert s["spans"][0]["args"] == {"k": 1}
+
+        code, _, body = _get(srv.url + "/nope")
+        assert code == 404 and "routes" in json.loads(body)
+    finally:
+        srv.stop()
+    srv.stop()  # idempotent
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(srv.url + "/metrics", timeout=2)
+
+
+def test_snapshot_events_bounded():
+    tr = Tracer(enabled=True)
+    for i in range(5):
+        with tr.span("op", i=i):
+            pass
+    srv = TelemetryServer(registry=MetricsRegistry(), tracer=tr,
+                          snapshot_events=2)
+    snap = srv.snapshot()  # body builder exercised directly — no socket
+    assert [e["args"]["i"] for e in snap["spans"]] == [3, 4]
+    assert snap["span_counts"] == {"op": 5}
+
+
+def test_healthz_watchdog_stall_flips_503():
+    from dcnn_tpu.resilience.guards import StallWatchdog
+
+    fc = FakeClock()
+    reg = MetricsRegistry(clock=fc)
+    wd = StallWatchdog(5.0, clock=fc, registry=reg)  # never start()ed
+    srv = TelemetryServer(registry=reg, clock=fc).add_check(
+        "watchdog", watchdog_check(wd)).start()
+    try:
+        code, _, body = _get(srv.url + "/healthz")
+        assert code == 200
+        fc.advance(6.0)  # past timeout_s, no beat: stalled
+        with pytest.warns(UserWarning):
+            code, _, body = _get(srv.url + "/healthz")
+        h = json.loads(body)
+        assert code == 503 and h["status"] == "unhealthy"
+        assert h["checks"]["watchdog"]["ok"] is False
+        assert "stalled" in h["reasons"][0]
+        # the registry stall flags ride along for the scraper
+        assert h["flags"]["train_stalled"] == 1
+        wd.beat()  # recovery: next scrape is healthy again
+        code, _, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["flags"][
+            "train_stalled"] == 0
+    finally:
+        srv.stop()
+
+
+def test_healthz_corrupt_checkpoint_flips_503():
+    class RottingManager:  # injectable fake: check() is the real contract
+        def check(self):
+            raise RuntimeError("async save failed: checksum mismatch")
+
+    class HealthyManager:
+        def check(self):
+            return None
+
+    srv = TelemetryServer(registry=MetricsRegistry()).add_check(
+        "checkpoint", checkpoint_check(HealthyManager())).start()
+    try:
+        code, _, _ = _get(srv.url + "/healthz")
+        assert code == 200
+    finally:
+        srv.stop()
+
+    srv = TelemetryServer(registry=MetricsRegistry()).add_check(
+        "checkpoint", checkpoint_check(RottingManager())).start()
+    try:
+        code, _, body = _get(srv.url + "/healthz")
+        h = json.loads(body)
+        assert code == 503
+        assert "checkpoint save failing" in h["checks"]["checkpoint"][
+            "reason"]
+        assert "checksum mismatch" in h["reasons"][0]
+    finally:
+        srv.stop()
+
+
+def test_checkpoint_health_probe_is_latching_and_non_consuming(tmp_path):
+    """A real CheckpointManager with a failing async save: the /healthz
+    probe must (a) stay degraded across repeated scrapes, and (b) NOT
+    steal the failure from the trainer's own one-shot check() fail-fast."""
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.resilience.checkpoint import CheckpointManager
+    from dcnn_tpu.train.trainer import create_train_state
+
+    model = (SequentialBuilder("ck").input((4,)).dense(2).build())
+    opt = Adam(1e-3)
+    ts = create_train_state(model, opt, jax.random.PRNGKey(0))
+
+    def bad_write(path, data):
+        raise OSError("disk full")
+
+    cm = CheckpointManager(str(tmp_path), io_write=bad_write,
+                           registry=MetricsRegistry())
+    try:
+        fut = cm.save_async(1, model, ts.params, ts.state, ts.opt_state,
+                            opt, {})
+        assert isinstance(fut.exception(timeout=30), OSError)
+        chk = checkpoint_check(cm)
+        assert "disk full" in chk()
+        assert "disk full" in chk()  # second scrape: still degraded
+        with pytest.raises(OSError):
+            cm.check()               # trainer fail-fast NOT disarmed
+        assert "disk full" in chk()  # latched even after check() consumed
+    finally:
+        cm.close()
+
+
+def test_healthz_registry_stall_flag_without_check():
+    # a process that wired a watchdog to the registry but not to the
+    # server still degrades: the gauge alone flips /healthz
+    reg = MetricsRegistry()
+    reg.gauge("train_stalled").set(1)
+    code, body = TelemetryServer(registry=reg).health()
+    assert code == 503 and "train_stalled" in body["reasons"][0]
+
+
+def test_health_check_exception_counts_as_degraded():
+    srv = TelemetryServer(registry=MetricsRegistry())
+    srv.add_check("boom", lambda: (_ for _ in ()).throw(OSError("disk")))
+    code, body = srv.health()
+    assert code == 503 and "OSError" in body["checks"]["boom"]["reason"]
+
+
+# ------------------------------------------------------------ serve wiring
+
+def _tiny_engine(max_batch=4):
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.serve import InferenceEngine
+    from dcnn_tpu.train.trainer import create_train_state
+
+    model = (SequentialBuilder("obs_srv").input((1, 8, 8))
+             .conv2d(4, 3, 1, 1).activation("relu").flatten().dense(10)
+             .build())
+    ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(0))
+    return InferenceEngine.from_model(model, ts.params, ts.state,
+                                      max_batch=max_batch)
+
+
+def test_engine_cost_stats_and_compile_counters():
+    from dcnn_tpu.obs import get_registry
+
+    before = get_registry().counter("compile_total").value
+    eng = _tiny_engine(max_batch=4)
+    # one compile per bucket, all counted on the shared registry
+    assert get_registry().counter("compile_total").value \
+        == before + len(eng.bucket_sizes)
+    top = eng.compile_stats[eng.max_batch]
+    # XLA cost analysis attached per bucket (CPU backend exposes it)
+    assert top["flops"] > 0 and top["bytes_accessed"] > 0
+    assert top["bytes_per_flop"] == pytest.approx(
+        top["bytes_accessed"] / top["flops"])
+    assert get_registry().gauge("serve_flops_per_sample").value > 0
+
+
+def test_batcher_telemetry_lifecycle():
+    from dcnn_tpu.serve import DynamicBatcher
+
+    eng = _tiny_engine()
+    b = DynamicBatcher(eng, start=False)  # synchronous: fully deterministic
+    srv = b.start_telemetry()
+    try:
+        fut = b.submit(np.zeros((1, 8, 8), np.float32))
+        b.step()
+        assert fut.result(timeout=10).shape == (10,)
+
+        code, hdrs, body = _get(srv.url + "/metrics")
+        text = body.decode()
+        assert code == 200
+        assert_exposition_conformant(text)
+        # the serve exposition (registry + windowed gauges), not the bare
+        # global registry — the exact-percentile series must be present
+        assert "serve_samples_completed_total 1" in text
+        assert "serve_latency_window_p99_ms" in text
+        # engine cost gauges AND compile accounting mirrored onto the
+        # (private) scrape registry
+        assert "serve_flops_per_sample" in text
+        assert f"compile_total {len(eng.bucket_sizes)}" in text
+
+        code, _, _ = _get(srv.url + "/healthz")
+        assert code == 200
+
+        code, _, body = _get(srv.url + "/snapshot")
+        s = json.loads(body)
+        assert s["serve"]["requests_completed"] == 1
+        assert s["engine"]["buckets"] == eng.bucket_sizes
+        assert s["engine"]["compile_stats"][str(eng.max_batch)]["flops"] > 0
+
+        b.drain()  # draining replica: scrapeable but unhealthy — the
+        # router contract: stop routing BEFORE requests fail
+        code, _, body = _get(srv.url + "/healthz")
+        h = json.loads(body)
+        assert code == 503 and "draining" in h["reasons"][0]
+        code, _, _ = _get(srv.url + "/metrics")
+        assert code == 200
+    finally:
+        b.shutdown()
+    assert b._telemetry is None
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(srv.url + "/healthz", timeout=2)
+
+
+# ----------------------------------------------------------- train wiring
+
+def test_trainer_live_scrape(tmp_path):
+    """A LIVE training process (mid-epoch, gated on an event — no sleeps)
+    answers /metrics, /healthz and /snapshot on its ephemeral port; the
+    server is gone after fit() returns."""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train.trainer import Trainer, create_train_state
+
+    model = (SequentialBuilder("obs_live").input((1, 8, 8))
+             .conv2d(2, 3, 1, 1).activation("relu").flatten().dense(10)
+             .build())
+    x = np.zeros((4, 1, 8, 8), np.float32)
+    y = np.eye(10, dtype=np.float32)[np.zeros(4, int)]
+
+    class GatedLoader:
+        batch_size = 4
+
+        def __init__(self):
+            self.mid_epoch = threading.Event()
+            self.release = threading.Event()
+
+        def __iter__(self):
+            yield x, y
+            self.mid_epoch.set()
+            assert self.release.wait(60)
+            yield x, y
+
+    cfg = TrainingConfig(epochs=1, snapshot_dir=None, metrics_port=0,
+                         progress_interval=0)
+    trainer = Trainer(model, Adam(1e-3), softmax_cross_entropy, cfg)
+    ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(0))
+    loader = GatedLoader()
+    err = []
+
+    def run():
+        try:
+            trainer.fit(ts, loader, epochs=1)
+        except BaseException as e:  # surfaced after join
+            err.append(e)
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        assert loader.mid_epoch.wait(60), "training never reached batch 1"
+        srv = trainer.telemetry
+        assert srv is not None
+        code, hdrs, body = _get(srv.url + "/metrics")
+        assert code == 200 and hdrs["Content-Type"] == CONTENT_TYPE
+        assert_exposition_conformant(body.decode())
+        code, _, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, _, body = _get(srv.url + "/snapshot")
+        assert code == 200 and "metrics" in json.loads(body)
+        url = srv.url
+    finally:
+        loader.release.set()
+        th.join(120)
+    assert not err, err
+    assert trainer.telemetry is None  # stopped by fit()'s finally
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=2)
+
+
+def test_start_telemetry_twice_replaces_not_leaks():
+    from dcnn_tpu.serve import DynamicBatcher
+
+    eng = _tiny_engine()
+    b = DynamicBatcher(eng, start=False)
+    try:
+        first = b.start_telemetry()
+        first_url = first.url
+        second = b.start_telemetry()
+        assert b._telemetry is second
+        # the first server's port is released, the second one answers
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(first_url + "/healthz", timeout=2)
+        code, _, body = _get(second.url + "/metrics")
+        assert code == 200
+        # compile counters mirrored exactly once across both calls
+        assert f"compile_total {len(eng.bucket_sizes)}" in body.decode()
+    finally:
+        b.shutdown()
+
+
+def test_trainer_server_bind_failure_stops_watchdog():
+    """A failed telemetry bind (fixed port already in use) must not leak
+    the already-started stall watchdog."""
+    import socket
+
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train.trainer import Trainer, create_train_state
+
+    blocker = socket.socket()
+    try:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        model = SequentialBuilder("bindfail").input((4,)).dense(2).build()
+        cfg = TrainingConfig(epochs=1, snapshot_dir=None,
+                             metrics_port=port, stall_timeout_s=60,
+                             progress_interval=0)
+        trainer = Trainer(model, Adam(1e-3), softmax_cross_entropy, cfg)
+        ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(0))
+        with pytest.raises(OSError):
+            trainer.fit(ts, [], epochs=1)
+        assert trainer.watchdog is None and trainer.telemetry is None
+    finally:
+        blocker.close()
+
+
+# --------------------------------------------------------------- obs/xla
+
+def test_jit_cost_of_real_executable():
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: jnp.tanh(a @ b).sum())
+    a = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    cost = obs_xla.jit_cost(f, a, a)
+    assert cost is not None and cost["flops"] > 2 * 32 ** 3 * 0.9
+    assert cost["bytes_accessed"] > 0
+    assert cost["bytes_per_flop"] == pytest.approx(
+        cost["bytes_accessed"] / cost["flops"])
+
+
+def test_jit_cost_failure_is_none():
+    class NotJitted:
+        def lower(self, *a, **k):
+            raise TypeError("nope")
+
+    assert obs_xla.jit_cost(NotJitted(), 1) is None
+    assert obs_xla.executable_cost(object()) is None
+
+
+def test_record_compile_counters():
+    reg = MetricsRegistry()
+    obs_xla.record_compile(2.5, what="unit", registry=reg)
+    obs_xla.record_compile(1.5, what="unit", registry=reg)
+    snap = reg.snapshot()
+    assert snap["compile_total"] == 2
+    assert snap["compile_seconds_total"] == pytest.approx(4.0)
+    assert snap["compile_unit_seconds_total"] == pytest.approx(4.0)
+
+
+def test_analytic_mfu():
+    assert obs_xla.analytic_mfu(2e9, 1000.0, 197.0) == pytest.approx(
+        2e12 / 197e12)
+    assert obs_xla.analytic_mfu(None, 1000.0, 197.0) is None
+    assert obs_xla.analytic_mfu(2e9, 1000.0, None) is None
+
+
+def test_sample_hbm_watermark_and_latch(monkeypatch):
+    class Dev:
+        def __init__(self, in_use, peak):
+            self._s = {"bytes_in_use": in_use, "bytes_limit": 16 << 30,
+                       "peak_bytes_in_use": peak}
+
+        def memory_stats(self):
+            return self._s
+
+    monkeypatch.setattr(obs_xla, "_HBM_SUPPORTED", None)
+    reg = MetricsRegistry()
+    s = obs_xla.sample_hbm(reg, devices=[Dev(1 << 30, 2 << 30),
+                                         Dev(3 << 30, 4 << 30)])
+    assert s["hbm_bytes_in_use"] == 4 << 30
+    assert s["hbm_bytes_limit"] == 32 << 30
+    assert s["hbm_peak_bytes"] == 4 << 30
+    # the watermark is monotone: a lower later sample never regresses it
+    obs_xla.sample_hbm(reg, devices=[Dev(1 << 20, 1 << 20)])
+    assert reg.gauge("hbm_peak_bytes").value == 4 << 30
+
+    # CPU (no stats) latches unsupported: later calls are free no-ops
+    monkeypatch.setattr(obs_xla, "_HBM_SUPPORTED", None)
+    assert obs_xla.sample_hbm(reg) is None  # jax CPU devices: stats None
+    assert obs_xla._HBM_SUPPORTED is False
+    assert obs_xla.sample_hbm(reg) is None
+
+
+# ------------------------------------------------------ tracer satellites
+
+def test_flush_jsonl_plain_and_gzip(tmp_path):
+    fc = FakeClock()
+    t = Tracer(clock=fc, enabled=True)
+    for i in range(4):
+        with t.span("op", i=i):
+            fc.advance(0.5)
+    plain = str(tmp_path / "t.jsonl")
+    t.export_jsonl(plain)  # export does NOT clear
+    assert len(t) == 4
+    gz = str(tmp_path / "t.jsonl.gz")
+    t.flush_jsonl(gz, gzip=True)  # flush writes then clears
+    assert len(t) == 0
+    with open(plain) as f:
+        plain_evs = [json.loads(l) for l in f]
+    with gzip.open(gz, "rt") as f:
+        gz_evs = [json.loads(l) for l in f]
+    assert plain_evs == gz_evs
+    assert [e["args"]["i"] for e in gz_evs] == [0, 1, 2, 3]
+    assert all(e["dur_s"] == 0.5 for e in gz_evs)
+
+
+def test_flush_jsonl_concurrent_events_survive_and_epoch_persists(
+        tmp_path, monkeypatch):
+    """Events recorded DURING the flush write land in the buffer for the
+    next flush (never lost, never duplicated), and the tracer epoch is
+    untouched so timestamps stay monotone across flushes."""
+    fc = FakeClock()
+    t = Tracer(clock=fc, enabled=True)
+    with t.span("a"):
+        fc.advance(1.0)
+    orig = t._write_jsonl
+
+    def write_and_record(evs, path, gz):  # a recorder wins the race
+        orig(evs, path, gz)
+        with t.span("b"):
+            fc.advance(1.0)
+
+    monkeypatch.setattr(t, "_write_jsonl", write_and_record)
+    p1 = str(tmp_path / "f1.jsonl")
+    t.flush_jsonl(p1)
+    monkeypatch.setattr(t, "_write_jsonl", orig)
+    assert [e["name"] for e in t.events()] == ["b"]  # survived the flush
+    with open(p1) as f:
+        assert [json.loads(l)["name"] for l in f] == ["a"]
+    p2 = str(tmp_path / "f2.jsonl")
+    t.flush_jsonl(p2)
+    with open(p2) as f:
+        evs2 = [json.loads(l) for l in f]
+    assert [e["name"] for e in evs2] == ["b"]
+    assert evs2[0]["ts_s"] == 1.0  # same epoch as before the first flush
+    assert len(t) == 0
+
+
+def test_flush_jsonl_saturated_ring_never_overpops(tmp_path, monkeypatch):
+    """Ring AT CAPACITY during the flush write: eviction removes exported
+    events from the left while new ones arrive — the drain must stop at
+    the first unexported event instead of popping len(snapshot) blindly
+    (which would eat never-exported events)."""
+    fc = FakeClock()
+    t = Tracer(capacity=4, clock=fc, enabled=True)
+    for i in range(4):  # ring full: snapshot will be exactly capacity
+        with t.span("old", i=i):
+            fc.advance(1.0)
+    orig = t._write_jsonl
+
+    def write_and_record(evs, path, gz):
+        orig(evs, path, gz)
+        for j in range(2):  # evicts two exported 'old' events
+            with t.span("new", j=j):
+                fc.advance(1.0)
+
+    monkeypatch.setattr(t, "_write_jsonl", write_and_record)
+    p = str(tmp_path / "sat.jsonl")
+    t.flush_jsonl(p)
+    with open(p) as f:
+        assert [json.loads(l)["name"] for l in f] == ["old"] * 4
+    # both never-exported events survive; all exported ones are gone
+    assert [(e["name"], e["args"]["j"]) for e in t.events()] == [
+        ("new", 0), ("new", 1)]
+
+
+def test_flush_jsonl_failed_write_keeps_events(tmp_path):
+    t = Tracer(enabled=True)
+    with t.span("op"):
+        pass
+    bad = str(tmp_path / "dir_not_file")
+    os.makedirs(bad)
+    with pytest.raises(IsADirectoryError):
+        t.flush_jsonl(bad)
+    assert len(t) == 1  # clear only happens after a successful write
+
+
+def test_export_chrome_truncation_note(tmp_path):
+    fc = FakeClock()
+    t = Tracer(clock=fc, enabled=True)
+    for i in range(10):
+        with t.span("op", i=i):
+            fc.advance(0.1)
+    path = str(tmp_path / "trace.json")
+    t.export_chrome(path, max_events=4)
+    with open(path) as f:
+        evs = json.load(f)["traceEvents"]
+    real = [e for e in evs if e["ph"] in ("X", "i")]
+    note, spans = real[0], real[1:]
+    # newest 4 survive, and the drop is explicit — log-truncation style
+    assert [e["args"]["i"] for e in spans] == [6, 7, 8, 9]
+    assert note["name"] == "tracer.truncated" and note["ph"] == "i"
+    assert note["args"]["dropped_older_events"] == 6
+    assert "6 older events truncated" in note["args"]["note"]
+
+    # under the cap: no note, nothing dropped
+    t.export_chrome(path, max_events=100)
+    with open(path) as f:
+        evs = json.load(f)["traceEvents"]
+    assert [e["name"] for e in evs if e["ph"] != "M"] == ["op"] * 10
+
+    with pytest.raises(ValueError):
+        t.export_chrome(path, max_events=0)

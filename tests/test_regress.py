@@ -1,0 +1,192 @@
+"""Bench-history regression gate tests (dcnn_tpu/obs/regress.py +
+benchmarks/compare.py).
+
+Contracts:
+
+- the REAL committed BENCH_r01–r05 trajectory passes the gate (no false
+  alarm on the project's own history, including the 3x-noisy h2d series);
+- a planted ≥20% img/s regression appended to that same trajectory is
+  flagged, by name, with a nonzero CLI exit code;
+- direction (lower-is-better compile_s), the compile-cache-warmth
+  comparability guard, missing-metric skips, and window bounds behave as
+  documented;
+- ``benchmarks/compare.py --self-test`` (the fixture run CI executes)
+  passes — the gate is itself regression-tested.
+"""
+
+import copy
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from dcnn_tpu.obs import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPARE = os.path.join(REPO, "benchmarks", "compare.py")
+
+
+def _real_files():
+    files = regress.find_bench_files(REPO)
+    if len(files) < 2:
+        pytest.skip("repo carries < 2 BENCH_r*.json captures")
+    return files
+
+
+# ----------------------------------------------------------- unit: compare
+
+def _hist(*values, extra=()):
+    out = [{"value": v} for v in values]
+    for i, d in enumerate(extra):
+        out[i].update(d)
+    return out
+
+
+def test_improvement_and_in_tolerance_pass():
+    report = regress.compare(_hist(100.0, 110.0, 120.0))
+    assert report["ok"] and report["regressions"] == []
+    # 15% below the window best at 20% tolerance: pass
+    report = regress.compare(_hist(100.0, 120.0, 102.0))
+    assert report["ok"]
+
+
+def test_regression_past_tolerance_flagged():
+    report = regress.compare(_hist(100.0, 120.0, 90.0))  # -25% vs best
+    assert not report["ok"] and report["regressions"] == ["img_per_sec"]
+    row = next(r for r in report["metrics"] if r["metric"] == "img_per_sec")
+    assert row["verdict"] == "REGRESSED" and row["best"] == 120.0
+
+
+def test_baseline_is_window_best_not_mean():
+    # a weak early capture must not dilute the baseline: best-of-window
+    # is 120, and 90 regresses against it even though the mean is ~103
+    report = regress.compare(_hist(90.0, 100.0, 120.0, 90.0))
+    assert not report["ok"]
+
+
+def test_lower_is_better_direction():
+    hist = [{"phases": {"compile_s": 100.0, "compile_cache_hit": None}},
+            {"phases": {"compile_s": 160.0, "compile_cache_hit": None}}]
+    report = regress.compare(hist)  # +60% past the 50% tolerance
+    assert "compile_s" in report["regressions"]
+    hist[1]["phases"]["compile_s"] = 140.0  # +40%: within tolerance
+    assert regress.compare(hist)["ok"]
+
+
+def test_cache_warmth_guard_blocks_comparison():
+    hist = [{"phases": {"compile_s": 3.0, "compile_cache_hit": True}},
+            {"phases": {"compile_s": 150.0, "compile_cache_hit": False}}]
+    report = regress.compare(hist)
+    row = next(r for r in report["metrics"] if r["metric"] == "compile_s")
+    assert row["verdict"].startswith("skipped")
+    assert report["ok"]
+
+
+def test_missing_metric_and_empty_window_skip():
+    report = regress.compare([{"value": 10.0}, {"mfu": 0.4}])
+    rows = {r["metric"]: r["verdict"] for r in report["metrics"]}
+    assert rows["img_per_sec"].startswith("skipped")  # absent from newest
+    assert rows["mfu"].startswith("skipped")          # no prior capture
+    assert report["ok"]
+
+
+def test_window_bounds_lookback():
+    # the ancient 1000.0 capture is outside window=2 and must not gate
+    report = regress.compare(_hist(1000.0, 100.0, 105.0, 103.0), window=2)
+    assert report["ok"]
+    report = regress.compare(_hist(1000.0, 100.0, 105.0, 103.0), window=3)
+    assert not report["ok"]
+
+
+def test_compare_input_validation():
+    with pytest.raises(ValueError):
+        regress.compare([])
+    with pytest.raises(ValueError):
+        regress.compare(_hist(1.0, 2.0), window=0)
+    with pytest.raises(ValueError):
+        regress.compare(_hist(1.0, 2.0), tolerance=1.5)
+
+
+def test_get_path_and_load_capture(tmp_path):
+    assert regress.get_path({"a": {"b": 3}}, "a.b") == 3
+    assert regress.get_path({"a": 1}, "a.b") is None
+    wrapped = tmp_path / "BENCH_r01.json"
+    wrapped.write_text(json.dumps({"parsed": {"value": 5}}))
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"metric": "m", "value": 7}))
+    junk = tmp_path / "junk.json"
+    junk.write_text("{nope")
+    assert regress.load_capture(str(wrapped)) == {"value": 5}
+    assert regress.load_capture(str(bare))["value"] == 7
+    assert regress.load_capture(str(junk)) is None
+
+
+# ------------------------------------------- the committed real trajectory
+
+def test_real_trajectory_passes():
+    report = regress.compare_files(_real_files())
+    assert report["ok"], regress.format_report(report)
+    assert report["unparseable_files"] == []
+
+
+def test_planted_regression_on_real_trajectory_flagged(tmp_path):
+    """The acceptance shape: BENCH_r01–r05 as the fixture history, one
+    planted ≥20% img/s drop appended — the gate must name it."""
+    files = _real_files()
+    for f in files:
+        shutil.copy(f, tmp_path / os.path.basename(f))
+    newest = regress.load_capture(files[-1])
+    planted = copy.deepcopy(newest)
+    planted["value"] = round(newest["value"] * 0.75, 1)  # -25%
+    n = len(files) + 1
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "parsed": planted}))
+    report = regress.compare_files(regress.find_bench_files(str(tmp_path)))
+    assert not report["ok"]
+    assert "img_per_sec" in report["regressions"]
+
+    # CLI twin: nonzero exit on the planted file, zero on the real set
+    rc = subprocess.run(
+        [sys.executable, COMPARE, "--json"]
+        + regress.find_bench_files(str(tmp_path)),
+        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 1, rc.stdout + rc.stderr
+    assert "img_per_sec" in json.loads(rc.stdout)["regressions"]
+
+
+def test_gate_current_embeds_report():
+    files = _real_files()
+    current = regress.load_capture(files[-1])
+    report = regress.gate_current(current, REPO)
+    assert report is not None and "error" not in report
+    # the newest real capture re-gated against history incl. itself: ok
+    assert report["ok"]
+    assert report["baseline_files"] == files
+    assert regress.gate_current({"value": 1.0}, str(os.path.join(
+        REPO, "nonexistent-dir"))) is None  # no history -> None, no raise
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_self_test_passes():
+    rc = subprocess.run([sys.executable, COMPARE, "--self-test"],
+                        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    assert "self-test: PASS" in rc.stdout
+
+
+def test_cli_real_files_exit_zero():
+    _real_files()
+    rc = subprocess.run([sys.executable, COMPARE],
+                        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    assert "OK: no regressions" in rc.stdout
+
+
+def test_cli_usage_errors():
+    rc = subprocess.run([sys.executable, COMPARE, "one.json"],
+                        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 2

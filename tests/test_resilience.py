@@ -477,11 +477,11 @@ def test_guarded_step_skip_is_bit_identical_to_previous_step():
     assert int(ts2.step) == step_before     # the step did not count
 
     reg = get_registry()
-    before = reg.counter("train_skipped_steps").value
+    before = reg.counter("train_skipped_steps_total").value
     guard = StepGuard("skip_step")
     with pytest.warns(UserWarning, match="skipped"):
         assert guard.observe(7, True) == "skipped"
-    assert reg.counter("train_skipped_steps").value == before + 1
+    assert reg.counter("train_skipped_steps_total").value == before + 1
     assert guard.observe(8, False) == "ok"
     assert guard.consecutive_bad == 0
 
@@ -549,11 +549,11 @@ def test_trainer_skip_step_policy_survives_injected_nan():
     trainer = Trainer(model, opt, "softmax_crossentropy", config=cfg)
     ts = create_train_state(model, opt, jax.random.PRNGKey(0))
     reg = get_registry()
-    before = reg.counter("train_skipped_steps").value
+    before = reg.counter("train_skipped_steps_total").value
     with FaultPlan().arm("train.nonfinite_input", at=2, times=1):
         with pytest.warns(UserWarning, match="skipped"):
             ts = trainer.fit(ts, _loader(), epochs=1)
-    assert reg.counter("train_skipped_steps").value == before + 1
+    assert reg.counter("train_skipped_steps_total").value == before + 1
     assert trainer.guard.total_skipped == 1
     assert np.isfinite(trainer.history[-1]["train_loss"])
     # and params came out finite: the NaN batch never touched state
