@@ -38,7 +38,6 @@ def _shapes():
 
 
 def run():
-    import jax
     import jax.numpy as jnp
     from jax import lax
 

@@ -1,0 +1,36 @@
+"""Trace-safety & concurrency static-analysis suite.
+
+``python -m dcnn_tpu.analysis dcnn_tpu/`` runs three AST-based check
+families over the live package and exits non-zero on any unsuppressed
+finding — the pre-merge gate ``tools/check.sh`` chains after ruff:
+
+========  ===================  ==============================================
+check id  name                 what it catches
+========  ===================  ==============================================
+TS01      trace-host-sync      ``.item()``/``device_get``/``np.asarray`` in
+                               jit-reachable code
+TS02      trace-host-cast      ``float()``/``int()``/``bool()`` on traced
+                               values
+TS03      trace-print          ``print()`` in traced code (trace-time-only)
+TS04      global-rng           ``np.random.*`` global state in determinism-
+                               contract modules
+TS05      trace-impure         mutation of closed-over state in traced code
+CC01      guarded-by           unannotated / unlocked cross-thread attribute
+CC02      thread-lifecycle     threads neither joined nor daemon+finalizer
+CC03      resource-lifecycle   shm/HTTP-server/pool without context manager
+                               or ``__del__``
+AT01      atomic-commit        bare ``open(w)``/``np.save`` on commit paths
+========  ===================  ==============================================
+
+Suppression: append ``# dcnn: disable=<check-id>`` to the offending line
+(with a justification comment), or record the finding's stable key in
+``dcnn_tpu/analysis/baseline.json``. Lock annotations for CC01 use
+``# dcnn: guarded_by=<lock-attr>`` on the attribute's ``__init__``
+assignment. Full workflow: docs/static_analysis.md.
+"""
+
+from .core import (Baseline, Finding, all_checks, analyze_paths,
+                   load_project, unsuppressed, DEFAULT_BASELINE)
+
+__all__ = ["Baseline", "Finding", "all_checks", "analyze_paths",
+           "load_project", "unsuppressed", "DEFAULT_BASELINE"]

@@ -1,0 +1,101 @@
+"""CLI: ``python -m dcnn_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = unsuppressed
+findings, 2 = usage/internal error. ``--json`` emits a machine-readable
+report (the shape the bench/CI tooling consumes); default output is one
+``path:line: CHECK (symbol) message`` line per finding plus a summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .core import (Baseline, DEFAULT_BASELINE, all_checks, analyze_paths,
+                   unsuppressed)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dcnn_tpu.analysis",
+        description="Trace-safety / concurrency / atomicity static analysis")
+    p.add_argument("paths", nargs="*", default=["dcnn_tpu"],
+                   help="files or directories to analyze "
+                        "(default: dcnn_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="emit a JSON report instead of text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of accepted findings "
+                        "(default: the committed package baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", metavar="PATH",
+                   help="write a skeleton baseline covering every current "
+                        "unsuppressed finding, then exit 0")
+    p.add_argument("--checks", default=None,
+                   help="comma-separated check ids to run "
+                        "(default: all)")
+    p.add_argument("--list-checks", action="store_true",
+                   help="print the check-id table and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in the text output")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        for cid, check in sorted(all_checks().items()):
+            print(f"{cid}  {check.name:20s} {check.description}")
+        return 0
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"error: no such path {p!r}", file=sys.stderr)
+            return 2
+    checks = ([c.strip() for c in args.checks.split(",") if c.strip()]
+              if args.checks else None)
+    baseline = Baseline() if args.no_baseline else Baseline.load(
+        args.baseline)
+    t0 = time.perf_counter()
+    try:
+        findings = analyze_paths(args.paths, checks=checks,
+                                 baseline=baseline)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    wall = time.perf_counter() - t0
+    live = unsuppressed(findings)
+    if args.write_baseline:
+        # dogfood the committed-artifact discipline this suite enforces
+        # (resilience.atomic is deliberately jax-free, so the CLI stays
+        # importable on a bare host)
+        from ..resilience.atomic import write_file_atomic
+        write_file_atomic(args.write_baseline,
+                          Baseline.render(findings).encode("utf-8"))
+        print(f"wrote {len(live)} finding(s) to {args.write_baseline} — "
+              f"fill in the justifications before committing")
+        return 0
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "unsuppressed": len(live),
+            "suppressed": len(findings) - len(live),
+            "wall_s": round(wall, 3),
+            "checks": sorted(checks or all_checks()),
+        }, indent=2))
+    else:
+        shown = findings if args.show_suppressed else live
+        for f in shown:
+            print(f.render())
+        n_inline = sum(1 for f in findings if f.suppressed_by == "inline")
+        n_base = sum(1 for f in findings if f.suppressed_by == "baseline")
+        print(f"{len(live)} finding(s), {n_inline} inline-suppressed, "
+              f"{n_base} baselined ({wall:.2f}s)")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

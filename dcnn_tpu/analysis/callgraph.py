@@ -1,0 +1,203 @@
+"""Call-graph construction: which functions execute under a JAX trace?
+
+The trace-safety family needs the set of functions reachable from trace
+entry points — anything that runs while ``jax.jit`` (or ``pjit`` /
+``shard_map`` / ``pmap``) is tracing, because a host sync there either
+throws a ``TracerArrayConversionError`` at runtime or, worse, silently
+fences the dispatch pipeline (the 26.4k img/s device step degrades to
+host-latency-bound with a single stray ``float()``).
+
+Roots (functions that definitely trace):
+
+- defs decorated with ``jax.jit`` / ``jit`` / ``pjit`` / ``pmap`` /
+  ``functools.partial(jax.jit, ...)``;
+- defs passed as the first argument to a ``jax.jit(...)``-style call
+  (the ``return jax.jit(step, donate_argnums=...)`` factory idiom used
+  by ``make_train_step`` / ``make_shard_step``);
+- defs passed to trace-propagating combinators anywhere
+  (``value_and_grad`` / ``grad`` / ``vmap`` / ``remat`` / ``checkpoint``
+  / ``lax.scan`` / ``while_loop`` / ``cond`` / ``fori_loop`` /
+  ``switch`` / ``custom_vjp``) — their function arguments execute under
+  the caller's trace.
+
+Propagation from the roots:
+
+- calls by bare name to a function defined in the same module;
+- calls by bare name to a function imported with ``from X import name``
+  where some analyzed module defines ``name`` (matched by import-name
+  against definers — the one cross-module edge kind we resolve);
+- ``self.method()`` calls to methods of the same class;
+- nested defs of a traced function (trace bodies are written nested in
+  this codebase).
+
+Documented limitations (see docs/static_analysis.md): attribute calls
+other than ``self.*`` are not resolved (``model.apply`` does not pull
+``Sequential.apply`` into the traced set), resolution is name-based (no
+type inference), and dynamically-selected callees are invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import SourceModule
+
+JIT_WRAPPERS = {"jit", "pjit", "pmap", "shard_map"}
+PROPAGATING = {"value_and_grad", "grad", "vmap", "remat", "checkpoint",
+               "scan", "while_loop", "cond", "fori_loop", "switch",
+               "custom_vjp", "custom_jvp", "associative_scan"}
+
+
+def call_name(func: ast.AST) -> Optional[str]:
+    """Trailing name of a call target: ``jax.jit`` -> ``jit``,
+    ``jit`` -> ``jit``, anything else -> None."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def is_self_call(func: ast.AST) -> Optional[str]:
+    """``self.method(...)`` -> ``method``."""
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"):
+        return func.attr
+    return None
+
+
+FuncKey = Tuple[str, str]  # (module path, qualname)
+
+
+class FunctionIndex:
+    """Every def in the project, plus the name maps the walk resolves
+    against."""
+
+    def __init__(self, project: Dict[str, SourceModule]):
+        self.project = project
+        self.functions: Dict[FuncKey, ast.FunctionDef] = {}
+        # module -> bare name -> qualnames defined at module top level
+        self.module_defs: Dict[str, Dict[str, List[str]]] = {}
+        # bare name -> [(module, qualname)] over ALL modules (for
+        # from-import resolution)
+        self.by_name: Dict[str, List[FuncKey]] = {}
+        # module -> names brought in via ``from X import name``
+        self.from_imports: Dict[str, Set[str]] = {}
+        # (module, class name) -> method name -> qualname
+        self.methods: Dict[Tuple[str, str], Dict[str, str]] = {}
+        for path, mod in project.items():
+            self.module_defs[path] = {}
+            self.from_imports[path] = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = mod.qualname(node)
+                    self.functions[(path, qn)] = node
+                    self.by_name.setdefault(node.name, []).append((path, qn))
+                    parent = mod.parents.get(node)
+                    if isinstance(parent, ast.Module):
+                        self.module_defs[path].setdefault(
+                            node.name, []).append(qn)
+                    elif isinstance(parent, ast.ClassDef):
+                        self.methods.setdefault(
+                            (path, parent.name), {})[node.name] = qn
+                elif isinstance(node, ast.ImportFrom):
+                    for alias in node.names:
+                        self.from_imports[path].add(alias.asname or alias.name)
+
+    def resolve_call(self, path: str, caller: ast.FunctionDef,
+                     func: ast.AST) -> List[FuncKey]:
+        """Possible definitions a call target refers to."""
+        mod = self.project[path]
+        self_m = is_self_call(func)
+        if self_m is not None:
+            cls = mod.enclosing_class(caller)
+            if cls is not None:
+                qn = self.methods.get((path, cls.name), {}).get(self_m)
+                if qn is not None:
+                    return [(path, qn)]
+            return []
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested def in the caller's own scope wins
+            for stmt in ast.walk(caller):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == name and stmt is not caller:
+                    return [(path, mod.qualname(stmt))]
+            local = self.module_defs.get(path, {}).get(name)
+            if local:
+                return [(path, q) for q in local]
+            if name in self.from_imports.get(path, set()):
+                return list(self.by_name.get(name, []))
+        return []
+
+
+def _function_args(call: ast.Call) -> List[ast.AST]:
+    return list(call.args) + [kw.value for kw in call.keywords]
+
+
+def traced_functions(project: Dict[str, SourceModule]
+                     ) -> Dict[FuncKey, str]:
+    """``{(module, qualname): reason}`` for every function in the traced
+    set. ``reason`` names the root/edge that pulled it in (diagnostics)."""
+    index = FunctionIndex(project)
+    traced: Dict[FuncKey, str] = {}
+    work: List[FuncKey] = []
+
+    def add(key: FuncKey, reason: str) -> None:
+        if key not in traced and key in index.functions:
+            traced[key] = reason
+            work.append(key)
+
+    # -- roots --
+    for path, mod in project.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    name = call_name(target)
+                    if name in JIT_WRAPPERS:
+                        add((path, mod.qualname(node)), f"@{name}")
+                    elif name == "partial" and isinstance(dec, ast.Call):
+                        inner = [call_name(a) for a in dec.args]
+                        if any(n in JIT_WRAPPERS for n in inner):
+                            add((path, mod.qualname(node)), "partial(jit)")
+            elif isinstance(node, ast.Call):
+                name = call_name(node.func)
+                if name in JIT_WRAPPERS and node.args:
+                    caller = mod.enclosing_function(node)
+                    if caller is not None and isinstance(
+                            node.args[0], ast.Name):
+                        for key in index.resolve_call(path, caller,
+                                                      node.args[0]):
+                            add(key, f"passed to {name}()")
+                elif name in PROPAGATING:
+                    caller = mod.enclosing_function(node)
+                    if caller is None:
+                        continue
+                    for arg in _function_args(node):
+                        if isinstance(arg, ast.Name):
+                            for key in index.resolve_call(path, caller, arg):
+                                add(key, f"passed to {name}()")
+
+    # -- propagation --
+    while work:
+        path, qn = work.pop()
+        fn = index.functions[(path, qn)]
+        mod = project[path]
+        # nested defs are trace bodies
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                add((path, mod.qualname(node)), f"nested in {qn}")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                for key in index.resolve_call(path, fn, node.func):
+                    add(key, f"called from {qn}")
+                for arg in _function_args(node):
+                    if isinstance(arg, ast.Name) \
+                            and call_name(node.func) in PROPAGATING:
+                        for key in index.resolve_call(path, fn, arg):
+                            add(key, f"passed from {qn}")
+    return traced

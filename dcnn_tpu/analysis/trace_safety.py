@@ -1,0 +1,315 @@
+"""Trace-safety checks (TS01-TS05).
+
+What "traced" means here is computed by :mod:`.callgraph`: the set of
+functions reachable from ``jax.jit`` / ``pjit`` / ``shard_map`` / ``pmap``
+entry points and the trace-propagating combinators. Inside that set:
+
+- **TS01 trace-host-sync** — calls that force a device round-trip or
+  host materialization: ``.item()``, ``.tolist()``,
+  ``.block_until_ready()``, ``jax.block_until_ready``, ``jax.device_get``,
+  and ``np.asarray`` / ``np.array`` / ``np.copy`` applied to a traced
+  parameter. On a tracer these either raise at trace time or compile a
+  silent pipeline fence; either way the 26.4k img/s step dies.
+- **TS02 trace-host-cast** — ``float()`` / ``int()`` / ``bool()`` /
+  ``complex()`` over an expression that mentions a traced parameter
+  (``x.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` subtrees are static
+  and exempt).
+- **TS03 trace-print** — ``print()`` in traced code. It fires once per
+  TRACE, not per step — almost never what the author meant; the
+  supported form is ``jax.debug.print``.
+- **TS05 trace-impure** — mutation of state that outlives the trace:
+  ``global`` / ``nonlocal`` writes, assignment or augmented assignment
+  through an attribute/subscript rooted at a closed-over name (or
+  ``self``), and mutator-method calls (``append`` / ``update`` / ...) on
+  closed-over names. Traced functions run ONCE at trace time; such
+  mutations happen at trace time only and silently stop happening per
+  step.
+
+Outside the traced set:
+
+- **TS04 global-rng** — ``np.random.*`` global-state functions (and
+  stdlib ``random.*`` module-level calls) inside the determinism-contract
+  modules (``data/workers.py``, ``data/augment.py``,
+  ``data/streaming.py``): the feed/serve/checkpoint bit-exactness
+  contracts require every draw to flow from a seeded ``Generator``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .callgraph import call_name, traced_functions
+from .core import Finding, SourceModule, register
+
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+HOST_SYNC_FUNCS = {"device_get", "block_until_ready"}
+HOST_MATERIALIZE = {"asarray", "array", "copy"}  # np.<name>(param)
+HOST_CASTS = {"float", "int", "bool", "complex"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+MUTATORS = {"append", "extend", "add", "update", "remove", "discard",
+            "pop", "popleft", "appendleft", "clear", "insert",
+            "setdefault", "sort", "reverse", "write"}
+
+# modules bound by the bit-exactness determinism contract (suffix match)
+DETERMINISM_MODULES = ("data/workers.py", "data/augment.py",
+                      "data/streaming.py")
+
+# np.random attributes that do NOT touch the global BitGenerator
+SEEDED_RNG_OK = {"Generator", "default_rng", "SeedSequence", "PCG64",
+                 "Philox", "SFC64", "MT19937", "BitGenerator", "RandomState"}
+
+_STDLIB_RANDOM_GLOBALS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "seed", "gauss", "normalvariate", "betavariate",
+    "expovariate", "getrandbits", "triangular",
+}
+
+
+def _np_random_attr(node: ast.AST) -> Optional[str]:
+    """``np.random.X`` / ``numpy.random.X`` -> ``X``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "random"
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id in ("np", "numpy")):
+        return node.attr
+    return None
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    """Parameters plus names bound inside ``fn`` itself (excluding nested
+    defs' internals) — anything NOT in this set that the body touches is
+    closed-over or global."""
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            # declared, but NOT local for purity purposes
+            names.difference_update(node.names)
+    return names
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    """Walk ``fn`` without descending into nested function/class defs —
+    nested defs are separate entries in the traced set and are checked on
+    their own."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _params(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    out = {arg.arg for arg in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    out.discard("self")
+    return out
+
+
+def _mentions_param(node: ast.AST, params: Set[str]) -> bool:
+    """Does the expression reference a traced parameter in a non-static
+    position? ``x`` yes; ``x.shape[0]`` no (static at trace time)."""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in params
+    return any(_mentions_param(c, params) for c in ast.iter_child_nodes(node))
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute/subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_TRACED_CACHE: dict = {}
+
+
+def _iter_traced(project: Dict[str, SourceModule]):
+    # the four traced-set checks run over one project object per
+    # analyze_paths call; build the call graph once, not once per check
+    from .callgraph import FunctionIndex
+    cached = _TRACED_CACHE.get(id(project))
+    if cached is not None and cached[0] is project:
+        traced, index = cached[1], cached[2]
+    else:
+        traced = traced_functions(project)
+        index = FunctionIndex(project)
+        _TRACED_CACHE.clear()
+        _TRACED_CACHE[id(project)] = (project, traced, index)
+    for key in sorted(traced):
+        path, qn = key
+        yield path, project[path], qn, index.functions[key]
+
+
+@register("TS01", "trace-host-sync",
+          "host sync / host materialization inside traced code")
+def check_host_sync(project: Dict[str, SourceModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, mod, qn, fn in _iter_traced(project):
+        params = _params(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in HOST_SYNC_ATTRS \
+                    and not node.args:
+                out.append(Finding(
+                    "TS01", path, node.lineno, qn, f.attr,
+                    f".{f.attr}() forces a device->host sync inside traced "
+                    f"code; return the value and read it outside the jit "
+                    f"boundary"))
+            elif call_name(f) in HOST_SYNC_FUNCS \
+                    and isinstance(f, ast.Attribute):
+                out.append(Finding(
+                    "TS01", path, node.lineno, qn, f.attr,
+                    f"jax.{f.attr}() inside traced code is a host sync; "
+                    f"hoist it out of the traced function"))
+            elif (isinstance(f, ast.Attribute) and f.attr in HOST_MATERIALIZE
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy") and node.args
+                    and _mentions_param(node.args[0], params)):
+                out.append(Finding(
+                    "TS01", path, node.lineno, qn, f"np.{f.attr}",
+                    f"np.{f.attr}() on a traced value materializes on host "
+                    f"(TracerArrayConversionError at runtime); use jnp"))
+    return out
+
+
+@register("TS02", "trace-host-cast",
+          "float()/int()/bool() on a traced value inside traced code")
+def check_host_cast(project: Dict[str, SourceModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, mod, qn, fn in _iter_traced(project):
+        params = _params(fn)
+        for node in _own_nodes(fn):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id in HOST_CASTS and len(node.args) == 1
+                    and _mentions_param(node.args[0], params)):
+                out.append(Finding(
+                    "TS02", path, node.lineno, qn, node.func.id,
+                    f"{node.func.id}() on a traced value is a concretization "
+                    f"(host sync / TracerBoolConversionError); keep it a "
+                    f"jnp scalar or read it outside the jit boundary"))
+    return out
+
+
+@register("TS03", "trace-print", "print() inside traced code")
+def check_trace_print(project: Dict[str, SourceModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, mod, qn, fn in _iter_traced(project):
+        for node in _own_nodes(fn):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                out.append(Finding(
+                    "TS03", path, node.lineno, qn, "print",
+                    "print() in traced code runs once at trace time, not "
+                    "per step; use jax.debug.print for per-step output"))
+    return out
+
+
+@register("TS04", "global-rng",
+          "global-state RNG in a determinism-contract module")
+def check_global_rng(project: Dict[str, SourceModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, mod in project.items():
+        if not path.endswith(DETERMINISM_MODULES):
+            continue
+        for node in ast.walk(mod.tree):
+            attr = _np_random_attr(node)
+            if attr is not None and attr not in SEEDED_RNG_OK:
+                out.append(Finding(
+                    "TS04", path, node.lineno, mod.qualname(
+                        mod.enclosing_function(node) or mod.tree), attr,
+                    f"np.random.{attr} uses the process-global BitGenerator; "
+                    f"this module is under the bit-exactness contract — "
+                    f"derive a seeded Generator (e.g. shard_rng) instead"))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "random"
+                    and node.func.attr in _STDLIB_RANDOM_GLOBALS):
+                out.append(Finding(
+                    "TS04", path, node.lineno, mod.qualname(
+                        mod.enclosing_function(node) or mod.tree),
+                    f"random.{node.func.attr}",
+                    f"stdlib random.{node.func.attr}() draws from global "
+                    f"state; use a seeded random.Random / np Generator"))
+    return out
+
+
+@register("TS05", "trace-impure",
+          "mutation of closed-over/global state inside traced code")
+def check_trace_impure(project: Dict[str, SourceModule]) -> List[Finding]:
+    out: List[Finding] = []
+    for path, mod, qn, fn in _iter_traced(project):
+        local = _local_names(fn)
+        declared_global: Set[str] = set()
+        for node in _own_nodes(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared_global.update(node.names)
+        for node in _own_nodes(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared_global:
+                    out.append(Finding(
+                        "TS05", path, node.lineno, qn, t.id,
+                        f"write to global/nonlocal '{t.id}' inside traced "
+                        f"code happens once at trace time, not per step"))
+                elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    root = _root_name(t)
+                    if root is not None and (root == "self"
+                                             or root not in local):
+                        out.append(Finding(
+                            "TS05", path, node.lineno, qn, root,
+                            f"mutation of closed-over state '{root}' inside "
+                            f"traced code is trace-time-only (and invisible "
+                            f"to the compiled step); thread state through "
+                            f"the carry instead"))
+            # mutator calls count only in statement position (result
+            # discarded): ``lst.append(x)`` mutates, ``opt.update(...)``
+            # assigned to a name is an API call returning new state. The
+            # chain root decides locality — ``self.history.append`` and
+            # ``cfg.stats.extend`` are closed-over mutations just like a
+            # bare ``acc.append``; only a root bound inside this function
+            # is trace-local and safe
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in MUTATORS:
+                node = node.value
+                root = _root_name(node.func.value)
+                if root is not None and (root == "self"
+                                         or root not in local):
+                    target = ast.unparse(node.func.value)
+                    out.append(Finding(
+                        "TS05", path, node.lineno, qn, root,
+                        f"'{target}.{node.func.attr}()' mutates closed-over "
+                        f"state inside traced code; it runs at trace time "
+                        f"only — return the data instead"))
+    return out

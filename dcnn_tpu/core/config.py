@@ -8,7 +8,7 @@ environment variables into the trainer. Same variable names are honored here.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, asdict
+from dataclasses import dataclass, asdict
 from enum import Enum
 from typing import Optional
 

@@ -26,7 +26,6 @@ import hashlib
 import io
 import os
 import struct
-import sys
 import tarfile
 import urllib.request
 import zipfile
@@ -96,12 +95,27 @@ def _idx_to_csv(images: bytes, labels: bytes, out_csv: str) -> None:
     px = memoryview(images)[16:]
     lb = memoryview(labels)[8:]
     d = rows * cols
-    with open(out_csv, "w") as f:
-        f.write("label," + ",".join(
-            f"{r+1}x{c+1}" for r in range(rows) for c in range(cols)) + "\n")
-        for i in range(n):
-            row = px[i * d:(i + 1) * d]
-            f.write(str(lb[i]) + "," + ",".join(map(str, row)) + "\n")
+    # stage + rename: loaders existence-check these CSVs to skip the
+    # download, so a run killed mid-write must not leave a torn file that
+    # every later run then parses as the dataset
+    tmp = f"{out_csv}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write("label," + ",".join(
+                f"{r+1}x{c+1}" for r in range(rows) for c in range(cols))
+                + "\n")
+            for i in range(n):
+                row = px[i * d:(i + 1) * d]
+                f.write(str(lb[i]) + "," + ",".join(map(str, row)) + "\n")
+        os.replace(tmp, out_csv)
+    except BaseException:
+        # disk-full / interrupt mid-write: don't litter the dataset dir
+        # with orphaned multi-MB tmp files nothing ever sweeps
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     print(f"wrote {out_csv} ({n} rows)")
 
 
@@ -150,8 +164,20 @@ def download_uji(root: str) -> None:
         for name in zf.namelist():
             base = os.path.basename(name)
             if base.lower() in ("trainingdata.csv", "validationdata.csv"):
-                with zf.open(name) as src, open(os.path.join(out, base), "wb") as dst:
-                    dst.write(src.read())
+                # same stage + rename discipline as _idx_to_csv: the
+                # extracted CSVs are the loader's cache-hit marker
+                dst_path = os.path.join(out, base)
+                tmp = f"{dst_path}.tmp-{os.getpid()}"
+                try:
+                    with zf.open(name) as src, open(tmp, "wb") as dst:
+                        dst.write(src.read())
+                    os.replace(tmp, dst_path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
                 found.add(base.lower())
     if found != {"trainingdata.csv", "validationdata.csv"}:
         raise SystemExit(

@@ -14,7 +14,7 @@ resamples them — same behavior as the reference's per-batch
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 

@@ -90,10 +90,17 @@ class TinyImageNetDataLoader(BaseDataLoader):
             else:
                 x, labels = self._load_val()
             if self.cache:
+                # stage + rename: a run preempted mid-save must not leave a
+                # torn .npz that the next run's cache hit np.load()s
+                tmp = f"{cache_path}.tmp-{os.getpid()}.npz"
                 try:
-                    np.savez(cache_path, x=x, labels=labels)
+                    np.savez(tmp, x=x, labels=labels)
+                    os.replace(tmp, cache_path)
                 except OSError:
-                    pass
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
         x = x.astype(np.float32) / 255.0
         x = np.transpose(x, (0, 3, 1, 2))  # HWC→CHW
         if self.data_format == "NHWC":

@@ -361,6 +361,16 @@ class ShmSlots:
                     pass
         self._segs = []
 
+    def __del__(self):
+        # a ring abandoned without close() (an injected slots= allocator
+        # whose pool construction raised, an interrupted test) must not
+        # leak named OS segments until the resource tracker's exit sweep;
+        # close() is idempotent and BufferError/FileNotFoundError-safe
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 class _SharedArray:
     """A read-only dataset copy in shared memory (spawn backend: the only

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# ASan/UBSan build of the native host kernels + the sanitize test driver.
+#
+# The production .so is built by dcnn_tpu/native/__init__.py with -O3 and
+# no instrumentation; this target is the "debug build" twin the reference
+# framework got from ENABLE_DEBUG -> -fsanitize: every gather / shuffle /
+# LZ4 / dataio round-trip in sanitize/main.cpp runs with AddressSanitizer
+# and UndefinedBehaviorSanitizer aborting on the first violation.
+#
+# Usage:
+#   native/build_sanitized.sh [output-binary]     # build only
+#   native/build_sanitized.sh --run [output]      # build, then run
+#
+# Exit codes: 0 built (and, with --run, ran clean); 2 no usable compiler /
+# sanitizer runtime (callers — the slow test — treat 2 as "skip").
+set -euo pipefail
+cd "$(dirname "$0")"
+
+RUN=0
+if [[ "${1:-}" == "--run" ]]; then
+  RUN=1
+  shift
+fi
+OUT="${1:-sanitize/dcnn_sanitize_test}"
+CXX="${CXX:-g++}"
+
+if ! command -v "$CXX" >/dev/null 2>&1; then
+  echo "build_sanitized: no C++ compiler ($CXX) on PATH" >&2
+  exit 2
+fi
+
+# probe: some minimal images ship g++ without libasan/libubsan — that is a
+# skip, not a failure
+probe="$(mktemp -d)"
+trap 'rm -rf "$probe"' EXIT
+echo 'int main(){return 0;}' > "$probe/p.cpp"
+if ! "$CXX" -fsanitize=address,undefined "$probe/p.cpp" -o "$probe/p" \
+    >/dev/null 2>&1; then
+  echo "build_sanitized: $CXX cannot link the sanitizer runtimes" >&2
+  exit 2
+fi
+
+mkdir -p "$(dirname "$OUT")"
+"$CXX" -std=c++17 -g -O1 -fno-omit-frame-pointer \
+  -fsanitize=address,undefined -fno-sanitize-recover=all \
+  -pthread src/*.cpp sanitize/main.cpp -o "$OUT"
+echo "built $OUT (ASan+UBSan)"
+
+if [[ "$RUN" == 1 ]]; then
+  case "$OUT" in
+    /*) BIN="$OUT" ;;
+    *) BIN="./$OUT" ;;
+  esac
+  ASAN_OPTIONS="${ASAN_OPTIONS:-detect_leaks=1}" \
+  UBSAN_OPTIONS="${UBSAN_OPTIONS:-print_stacktrace=1}" \
+  "$BIN"
+fi

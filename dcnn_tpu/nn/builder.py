@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
-from .layer import Layer, Shape
+from .layer import Layer
 from .layers import (
     ActivationLayer, AvgPool2DLayer, BatchNormLayer, Conv2DLayer, DenseLayer,
     DropoutLayer, FlattenLayer, GroupNormLayer, LogSoftmaxLayer, MaxPool2DLayer,

@@ -17,7 +17,7 @@ time for the concrete shapes, exactly like a jitted function.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

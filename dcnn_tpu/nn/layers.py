@@ -17,7 +17,7 @@ SequentialBuilder does the same inference at build time,
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +29,7 @@ from ..ops import norm as norm_ops
 from ..ops import pool as pool_ops
 from . import initializers as init
 from .factory import register_layer
-from .layer import Layer, ParameterizedLayer, Shape, StatelessLayer
+from .layer import ParameterizedLayer, Shape, StatelessLayer
 
 
 def _pair(v) -> Tuple[int, int]:

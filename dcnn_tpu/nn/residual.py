@@ -14,13 +14,13 @@ reference's recursive ``residual_block`` handling in the factory
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 
 from ..ops import activations as act_ops
 from .factory import layer_from_config, register_layer
-from .layer import Layer, Params, Shape, State
+from .layer import Layer
 
 
 @register_layer("residual_block")

@@ -76,7 +76,7 @@ def render_instruments(items) -> List[str]:
     """Exposition lines for ``(name, instrument)`` pairs of the registry's
     Counter / Gauge / Histogram kinds (import deferred — registry imports
     this module)."""
-    from .registry import Counter, Gauge, Histogram
+    from .registry import Counter, Histogram
 
     lines: List[str] = []
     for name, inst in items:

@@ -242,13 +242,25 @@ class Tracer:
     # -- exporters ---------------------------------------------------------
     def _write_jsonl(self, evs: list, path: str, gzip: bool) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # tmp sibling + os.replace: a crash mid-export must never leave a
+        # torn artifact at the published path (flush_jsonl's drop-nothing
+        # contract also depends on the failed write being invisible)
+        tmp = f"{path}.tmp-{os.getpid()}"
         opener = (lambda p: _gzip.open(p, "wt")) if gzip else \
             (lambda p: open(p, "w"))
-        with opener(path) as f:
-            for (n, ts, dur, track, attrs) in evs:
-                f.write(json.dumps({"name": n, "ts_s": ts, "dur_s": dur,
-                                    "track": track,
-                                    "args": dict(attrs)}) + "\n")
+        try:
+            with opener(tmp) as f:
+                for (n, ts, dur, track, attrs) in evs:
+                    f.write(json.dumps({"name": n, "ts_s": ts, "dur_s": dur,
+                                        "track": track,
+                                        "args": dict(attrs)}) + "\n")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def export_jsonl(self, path: str, *, gzip: bool = False) -> str:
         """One JSON object per line per event. ``gzip=True`` writes the
@@ -342,8 +354,19 @@ class Tracer:
                 ev["dur"] = round(dur * 1e6, 3)
             out.append(ev)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        # same commit discipline as _write_jsonl: never a torn trace at the
+        # path BENCH_OBS points the viewer at
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return path
 
 

@@ -47,8 +47,7 @@ rotation without any hand-written backward schedule.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp

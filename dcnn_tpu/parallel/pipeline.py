@@ -31,11 +31,10 @@ TPU-native mapping:
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.fence import hard_fence
 from ..nn.sequential import Sequential
@@ -557,6 +556,25 @@ class InProcessPipelineCoordinator:
             warnings.warn(f"pipeline join timed out after {timeout}s "
                           f"(stages may still be executing)", stacklevel=2)
             return False
+
+    def close(self) -> None:
+        """Release the persistent join-waiter thread promptly instead of
+        holding it for the rest of the process (an idle worker costs a
+        thread + stack until the interpreter's own executor sweep).
+        ``wait=False`` so a fence still stuck on a hung dispatch doesn't
+        turn teardown into a hang — though note the limit: CPython's
+        executor atexit hook joins pool threads regardless, so a
+        *wedged* fence can still pin interpreter exit; close() cannot
+        fix that, only reclaim the thread in the normal case."""
+        if self._join_executor is not None:
+            self._join_executor.shutdown(wait=False)
+            self._join_executor = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def forward_only(self, x, training: bool = False) -> jax.Array:
         h = jnp.asarray(x)

@@ -143,7 +143,9 @@ class FaultPlan:
         off = self.rng.randrange(len(data))
         bit = self.rng.randrange(8)
         data[off] ^= 1 << bit
-        with open(path, "wb") as f:
+        # in-place corruption IS the point here — this manufactures the
+        # torn/bit-flipped artifact the restore path must survive
+        with open(path, "wb") as f:  # dcnn: disable=AT01
             f.write(data)
         return off, bit
 

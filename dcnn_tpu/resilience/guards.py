@@ -130,27 +130,37 @@ class StallWatchdog:
         self._clock = clock
         self._reg = registry if registry is not None else get_registry()
         self._name = name
-        self._last_beat = clock()
-        self._flagged = False
+        # beat() runs on the training thread, check() on the poll thread:
+        # the beat/flag pair must change together or a beat landing between
+        # check()'s read and its flag write un-stalls a loop the poll
+        # thread is about to (wrongly) flag
+        self._lock = threading.Lock()
+        self._last_beat = clock()  # dcnn: guarded_by=_lock
+        self._flagged = False  # dcnn: guarded_by=_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self) -> None:
-        self._last_beat = self._clock()
-        if self._flagged:
-            self._flagged = False
+        with self._lock:
+            self._last_beat = self._clock()
+            was_flagged, self._flagged = self._flagged, False
+        if was_flagged:
             self._reg.gauge(f"{self._name}_stalled",
                             "1 while the loop is flagged stalled").set(0)
 
     def check(self) -> bool:
-        age = self._clock() - self._last_beat
+        with self._lock:
+            age = self._clock() - self._last_beat
+            stalled = age > self.timeout_s
+            newly = stalled and not self._flagged
+            if newly:
+                self._flagged = True
         self._reg.gauge(
             f"{self._name}_last_progress_age_s",
             "seconds since the loop last made progress").set(age)
-        if age <= self.timeout_s:
+        if not stalled:
             return False
-        if not self._flagged:
-            self._flagged = True
+        if newly:
             self._reg.counter(f"{self._name}_stall_flags_total",
                               "distinct stalls flagged").inc()
             self._reg.gauge(f"{self._name}_stalled",

@@ -96,11 +96,11 @@ class DynamicBatcher:
         self.metrics = metrics if metrics is not None else ServeMetrics(
             clock=clock)
         self._clock = clock
-        self._q: deque = deque()
-        self._rows = 0
+        self._q: deque = deque()  # dcnn: guarded_by=_cond
+        self._rows = 0  # dcnn: guarded_by=_cond
         # every accepted, not-yet-resolved future: the no-orphan guarantee's
-        # ledger (set ops are GIL-atomic; resolution paths discard)
-        self._accepted: set = set()
+        # ledger
+        self._accepted: set = set()  # dcnn: guarded_by=_cond
         self._cond = threading.Condition()
         self._closing = False
         self._thread: Optional[threading.Thread] = None
@@ -295,8 +295,9 @@ class DynamicBatcher:
                     except InvalidStateError:
                         pass
         finally:
-            for r in batch:
-                self._accepted.discard(r.future)
+            with self._cond:
+                for r in batch:
+                    self._accepted.discard(r.future)
 
     def step(self, force: bool = True) -> int:
         """Synchronously dispatch one batch (``start=False`` mode and

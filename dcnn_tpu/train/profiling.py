@@ -24,7 +24,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 

@@ -10,7 +10,7 @@ trainers here.
 from __future__ import annotations
 
 import os
-from typing import Callable, Optional, Type, TypeVar, Union
+from typing import Callable, Optional, Type, TypeVar
 
 T = TypeVar("T")
 

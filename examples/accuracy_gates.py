@@ -44,7 +44,6 @@ import dcnn_tpu  # noqa: F401  (platform override side effects)
 import jax
 
 from dcnn_tpu.core.precision import get_precision_mode, set_precision
-from dcnn_tpu.data import ArrayDataLoader
 from dcnn_tpu.nn.builder import SequentialBuilder
 from dcnn_tpu.optim import Adam
 from dcnn_tpu.train import Trainer

@@ -17,7 +17,6 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import jax  # noqa: E402
-import numpy as np  # noqa: E402
 from common import setup  # noqa: E402
 
 from dcnn_tpu.data import SyntheticClassificationLoader  # noqa: E402

@@ -8,7 +8,7 @@ MODEL (zoo name, default resnet9_cifar10), plus TrainingConfig vars.
 """
 
 import jax
-from common import loader_or_synthetic, setup
+from common import setup
 
 from dcnn_tpu.models import create_model
 from dcnn_tpu.optim import Adam

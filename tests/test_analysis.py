@@ -1,0 +1,538 @@
+"""Static-analysis suite: fixture tests per check id + the live gate.
+
+Contract per check id (TS01-TS05, CC01-CC03, AT01), each as its own
+test so a disabled/broken detector fails its own named test:
+
+- a minimal positive fixture produces the finding;
+- the same fixture with ``# dcnn: disable=<id>`` on the offending line
+  is inline-suppressed;
+- a baseline entry carrying the finding's stable key suppresses it;
+- the corrected/clean twin produces nothing.
+
+Plus: check-id attribution (running only other checks on a positive
+fixture yields nothing), CLI exit codes / JSON shape / --write-baseline
+round-trip, and the tier-1 gate — the LIVE package analyzed with the
+committed baseline has zero unsuppressed findings, in well under the
+30 s budget. Fixtures are parsed, never imported or executed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dcnn_tpu.analysis import (Baseline, DEFAULT_BASELINE, all_checks,
+                               analyze_paths, unsuppressed)
+
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "dcnn_tpu")
+
+
+def run_snippet(tmp_path, src, *, rel="snippet.py", checks=None,
+                baseline=None, phase="p0"):
+    """Write ``src`` at <tmp>/<phase>/pkg/<rel> and analyze the pkg root:
+    display paths (= baseline-key paths) come out as ``pkg/<rel>`` for
+    EVERY phase, so keys from one phase's findings address another
+    phase's file — exactly how the committed baseline addresses the live
+    tree — while each phase still analyzes only its own fixture."""
+    root = tmp_path / phase / "pkg"
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return analyze_paths([str(root)], checks=checks, baseline=baseline)
+
+
+def live(findings):
+    return unsuppressed(findings)
+
+
+def _quad(tmp_path, check_id, positive, clean, *, rel="snippet.py"):
+    """The four-way contract shared by every check id."""
+    hits = live(run_snippet(tmp_path, positive, rel=rel))
+    assert [f.check_id for f in hits].count(check_id) >= 1, \
+        f"{check_id} positive fixture produced {hits}"
+    hit = next(f for f in hits if f.check_id == check_id)
+
+    # inline suppression on the offending line
+    lines = textwrap.dedent(positive).splitlines()
+    lines[hit.line - 1] += f"  # dcnn: disable={check_id}"
+    sup = run_snippet(tmp_path, "\n".join(lines) + "\n", rel=rel,
+                      phase="inline")
+    sup_hits = [f for f in sup if f.check_id == check_id]
+    assert sup_hits and all(f.suppressed_by == "inline" for f in sup_hits)
+
+    # baseline suppression via the stable key (identical display path ->
+    # identical key across phases)
+    base = Baseline({f.key: "accepted for test" for f in hits})
+    based = run_snippet(tmp_path, positive, rel=rel, phase="baseline",
+                        baseline=base)
+    based_hits = [f for f in based if f.check_id == check_id]
+    assert based_hits and all(f.suppressed_by == "baseline"
+                              for f in based_hits)
+
+    # the clean twin passes
+    assert not [f for f in live(run_snippet(tmp_path, clean, rel=rel,
+                                            phase="clean"))
+                if f.check_id == check_id]
+
+    # attribution: every OTHER check stays silent on this positive fixture
+    others = [c for c in all_checks() if c != check_id]
+    others_hits = live(run_snippet(tmp_path, positive, rel=rel,
+                                   phase="attr", checks=others))
+    assert not [f for f in others_hits if f.check_id == check_id]
+    return hit
+
+
+# ---------------------------------------------------------------- TS01 --
+def test_ts01_host_sync(tmp_path):
+    hit = _quad(tmp_path, "TS01", """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x).sum()
+        """, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.asarray(x).sum()
+        """)
+    assert hit.detail == "np.asarray"
+
+
+def test_ts01_item_and_factory_entry(tmp_path):
+    # the jax.jit(step, ...) factory idiom must be a root too
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def make_step(model):
+            def step(ts, x):
+                loss = model(ts, x)
+                host = loss.item()
+                return host
+            return jax.jit(step, donate_argnums=(0,))
+        """))
+    assert any(f.check_id == "TS01" and f.detail == "item" for f in hits)
+
+
+def test_ts01_propagates_through_called_helper(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def helper(v):
+            return v.block_until_ready()
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """))
+    assert any(f.check_id == "TS01" and f.symbol == "helper" for f in hits)
+
+
+# ---------------------------------------------------------------- TS02 --
+def test_ts02_host_cast(tmp_path):
+    _quad(tmp_path, "TS02", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x) * 2.0
+        """, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x.shape[0]) * x
+        """)
+
+
+# ---------------------------------------------------------------- TS03 --
+def test_ts03_trace_print(tmp_path):
+    _quad(tmp_path, "TS03", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("loss", x)
+            return x
+        """, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("loss {}", x)
+            return x
+        """)
+
+
+# ---------------------------------------------------------------- TS04 --
+def test_ts04_global_rng(tmp_path):
+    _quad(tmp_path, "TS04", """
+        import numpy as np
+
+        def pick(n):
+            return np.random.randint(0, 10, size=n)
+        """, """
+        import numpy as np
+
+        def pick(n, rng: np.random.Generator):
+            return rng.integers(0, 10, size=n)
+        """, rel="data/augment.py")
+
+
+def test_ts04_only_in_contract_modules(tmp_path):
+    # the same global draw OUTSIDE a determinism-contract module is fine
+    hits = live(run_snippet(tmp_path, """
+        import numpy as np
+
+        def pick(n):
+            return np.random.randint(0, 10, size=n)
+        """, rel="util.py"))
+    assert not [f for f in hits if f.check_id == "TS04"]
+
+
+# ---------------------------------------------------------------- TS05 --
+def test_ts05_trace_impure(tmp_path):
+    _quad(tmp_path, "TS05", """
+        import jax
+
+        LOSSES = []
+
+        @jax.jit
+        def step(x):
+            LOSSES.append(x)
+            return x
+        """, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            losses = []
+            losses.append(x)
+            return x
+        """)
+
+
+def test_ts05_api_update_call_not_flagged(tmp_path):
+    # opt.update(...) whose result is consumed is an API call returning
+    # new state, not a dict mutation (the live make_train_step pattern)
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def make(opt):
+            def step(ts, g):
+                new_params, new_opt = opt.update(g, ts)
+                return new_params, new_opt
+            return jax.jit(step)
+        """))
+    assert not [f for f in hits if f.check_id == "TS05"]
+
+
+# ---------------------------------------------------------------- CC01 --
+_CC01_POSITIVE = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            self._n += 1
+
+        def read(self):
+            return self._n
+
+        def stop(self):
+            self._t.join()
+    """
+
+_CC01_CLEAN = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0  # dcnn: guarded_by=_lock
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            with self._lock:
+                return self._n
+
+        def stop(self):
+            self._t.join()
+    """
+
+
+def test_cc01_guarded_by(tmp_path):
+    hit = _quad(tmp_path, "CC01", _CC01_POSITIVE, _CC01_CLEAN)
+    assert hit.detail == "_n"
+    assert "guarded_by" in hit.message
+
+
+def test_cc01_annotated_but_unlocked_access(tmp_path):
+    # annotation alone is not enough: the read outside the lock is flagged
+    src = _CC01_CLEAN.replace(
+        "        def read(self):\n"
+        "            with self._lock:\n"
+        "                return self._n",
+        "        def read(self):\n"
+        "            return self._n")
+    hits = live(run_snippet(tmp_path, src))
+    assert any(f.check_id == "CC01" and "outside 'with self._lock'"
+               in f.message for f in hits)
+
+
+def test_cc01_nested_thread_body_reaches_methods(tmp_path):
+    # Thread(target=<nested fn>) whose body calls self.m — the live
+    # StallWatchdog.start shape
+    hits = live(run_snippet(tmp_path, """
+        import threading
+
+        class Dog:
+            def __init__(self):
+                self._flagged = False
+
+            def check(self):
+                self._flagged = True
+
+            def start(self):
+                def loop():
+                    self.check()
+                t = threading.Thread(target=loop, daemon=True)
+                t.start()
+                return t
+
+            def beat(self):
+                self._flagged = False
+
+            def stop(self):
+                pass
+        """))
+    assert any(f.check_id == "CC01" and f.detail == "_flagged" for f in hits)
+
+
+# ---------------------------------------------------------------- CC02 --
+def test_cc02_thread_lifecycle(tmp_path):
+    _quad(tmp_path, "CC02", """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+        """, """
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        """)
+
+
+def test_cc02_daemon_with_finalizer_ok(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                pass
+        """))
+    assert not [f for f in hits if f.check_id == "CC02"]
+
+
+# ---------------------------------------------------------------- CC03 --
+def test_cc03_resource_lifecycle(tmp_path):
+    _quad(tmp_path, "CC03", """
+        from multiprocessing import shared_memory
+
+        class Ring:
+            def __init__(self):
+                self.seg = shared_memory.SharedMemory(create=True, size=16)
+
+            def close(self):
+                self.seg.close()
+        """, """
+        from multiprocessing import shared_memory
+
+        class Ring:
+            def __init__(self):
+                self.seg = shared_memory.SharedMemory(create=True, size=16)
+
+            def close(self):
+                self.seg.close()
+
+            def __del__(self):
+                self.close()
+        """)
+
+
+def test_cc03_with_block_and_local_close_ok(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def a():
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                return pool.submit(len, ()).result()
+
+        def b():
+            pool = ThreadPoolExecutor(max_workers=1)
+            try:
+                return pool.submit(len, ()).result()
+            finally:
+                pool.shutdown()
+        """))
+    assert not [f for f in hits if f.check_id == "CC03"]
+
+
+# ---------------------------------------------------------------- AT01 --
+def test_at01_atomic_commit(tmp_path):
+    _quad(tmp_path, "AT01", """
+        def save(path, text):
+            with open(path, "w") as f:
+                f.write(text)
+        """, """
+        import os
+
+        def save(path, text):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        """)
+
+
+def test_at01_np_save_and_helper_exemption(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import numpy as np
+
+        def cache(path, x):
+            np.savez(path, x=x)
+        """))
+    assert any(f.check_id == "AT01" and f.detail == "np.savez" for f in hits)
+    hits = live(run_snippet(tmp_path, """
+        from dcnn_tpu.resilience.atomic import write_file_atomic
+
+        def cache(path, data):
+            write_file_atomic(path, data)
+        """, rel="ok.py", phase="helper"))
+    assert not [f for f in hits if f.check_id == "AT01"]
+
+
+# ------------------------------------------------------------ plumbing --
+def test_parse_error_is_a_finding(tmp_path):
+    hits = live(run_snippet(tmp_path, "def broken(:\n"))
+    assert [f.check_id for f in hits] == ["PARSE"]
+
+
+def test_unknown_check_id_raises(tmp_path):
+    with pytest.raises(ValueError, match="unknown check"):
+        run_snippet(tmp_path, "x = 1\n", checks=["NOPE"])
+
+
+def test_every_check_id_registered():
+    assert set(all_checks()) == {"TS01", "TS02", "TS03", "TS04", "TS05",
+                                 "CC01", "CC02", "CC03", "AT01"}
+
+
+# ------------------------------------------------------------------ CLI --
+def _cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "dcnn_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=300,
+        cwd=cwd or os.path.dirname(PKG_DIR))
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def save(p, t):\n"
+                   "    with open(p, 'w') as f:\n"
+                   "        f.write(t)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+
+    r = _cli(str(bad), "--no-baseline")
+    assert r.returncode == 1
+    assert "AT01" in r.stdout
+
+    r = _cli(str(good), "--no-baseline")
+    assert r.returncode == 0
+
+    r = _cli(str(tmp_path), "--no-baseline", "--json")
+    report = json.loads(r.stdout)
+    assert r.returncode == 1
+    assert report["unsuppressed"] == 1
+    assert report["findings"][0]["check_id"] == "AT01"
+    assert report["findings"][0]["key"].startswith(
+        f"AT01::{tmp_path.name}/bad.py::save")
+
+    r = _cli(str(bad), "--checks", "BOGUS")
+    assert r.returncode == 2
+
+    r = _cli("--list-checks")
+    assert r.returncode == 0 and "AT01" in r.stdout
+
+    r = _cli(str(tmp_path / "missing.py"))
+    assert r.returncode == 2
+
+
+def test_cli_write_baseline_round_trip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def save(p, t):\n"
+                   "    with open(p, 'w') as f:\n"
+                   "        f.write(t)\n")
+    base = tmp_path / "baseline.json"
+    r = _cli(str(bad), "--no-baseline", "--write-baseline", str(base))
+    assert r.returncode == 0
+    data = json.loads(base.read_text())
+    assert len(data["findings"]) == 1
+    # the skeleton suppresses the finding on the next run
+    r = _cli(str(bad), "--baseline", str(base))
+    assert r.returncode == 0
+
+
+# ------------------------------------------------------- the live gate --
+def test_live_package_zero_unsuppressed():
+    """THE acceptance gate: the shipped package, analyzed with the
+    committed baseline, is clean — and fast enough for tier-1."""
+    t0 = time.perf_counter()
+    findings = analyze_paths([PKG_DIR],
+                             baseline=Baseline.load(DEFAULT_BASELINE))
+    wall = time.perf_counter() - t0
+    bad = unsuppressed(findings)
+    assert not bad, "unsuppressed findings in the live tree:\n" + "\n".join(
+        f.render() for f in bad)
+    # every baseline entry must still match a real finding — a stale key
+    # is a fixed defect whose baseline entry now hides nothing and rots
+    matched = {f.key for f in findings if f.suppressed_by == "baseline"}
+    stale = set(Baseline.load(DEFAULT_BASELINE).entries) - matched
+    assert not stale, f"stale baseline entries: {sorted(stale)}"
+    assert wall < 30.0, f"analysis took {wall:.1f}s (budget 30s)"
+
+
+def test_live_cli_exit_zero():
+    r = _cli("dcnn_tpu")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -11,8 +11,7 @@ from dcnn_tpu.optim import SGD
 from dcnn_tpu.ops.losses import softmax_cross_entropy
 from dcnn_tpu.parallel.compiled_pipeline import (
     SequentialStageStack, make_compiled_pipeline_forward,
-    make_compiled_pipeline_train_step, shard_stacked, stack_stage_params,
-)
+    make_compiled_pipeline_train_step, shard_stacked, )
 
 KEY = jax.random.PRNGKey(0)
 S = 4       # stages

@@ -6,7 +6,6 @@ Reference analog: ``tiny_imagenet_loader_test.cpp`` (SURVEY.md §4.6).
 import os
 
 import numpy as np
-import pytest
 
 from dcnn_tpu.data import (
     ArrayDataLoader, AugmentationBuilder, CIFAR10DataLoader, CIFAR100DataLoader,

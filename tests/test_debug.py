@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dcnn_tpu.core.debug import checked, debug_mode, enable_debug_mode, disable_debug_mode
+from dcnn_tpu.core.debug import checked, debug_mode
 
 
 def test_debug_mode_catches_nan():

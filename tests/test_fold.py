@@ -6,12 +6,11 @@ including residual blocks and bias-less convolutions.
 """
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
-from dcnn_tpu.nn import BatchNormLayer, Sequential, SequentialBuilder, fold_batchnorm
+from dcnn_tpu.nn import BatchNormLayer, SequentialBuilder, fold_batchnorm
 from dcnn_tpu.optim import Adam
 from dcnn_tpu.ops.losses import softmax_cross_entropy
 from dcnn_tpu.train.trainer import create_train_state, make_train_step

@@ -94,7 +94,7 @@ def test_parse_label_csv_unparseable_defers_to_fallback(tmp_path):
 def test_loaders_use_native_and_match_fallback(tmp_path, rng, monkeypatch):
     """MNIST/CIFAR loaders must produce identical tensors through the native
     and numpy paths."""
-    from dcnn_tpu.data import CIFAR10DataLoader, MNISTDataLoader
+    from dcnn_tpu.data import CIFAR10DataLoader
 
     # CIFAR
     n = 5

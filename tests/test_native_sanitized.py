@@ -1,0 +1,40 @@
+"""Native kernels under AddressSanitizer + UBSan (slow tier).
+
+``native/build_sanitized.sh`` compiles ``src/*.cpp`` with
+``-fsanitize=address,undefined -fno-sanitize-recover=all`` together with
+the standalone round-trip driver (``sanitize/main.cpp``: gather, byte
+shuffle, LZ4 greedy+HC, dataio decode/parse — each with its reject-path
+edges). One passing run means none of those paths touched memory out of
+bounds or hit UB; the driver's own value checks also ran.
+
+Skips cleanly (never fails) when the host has no C++ compiler or ships
+g++ without the sanitizer runtimes — the build script signals that with
+exit code 2.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "dcnn_tpu", "native")
+_SCRIPT = os.path.join(_NATIVE_DIR, "build_sanitized.sh")
+
+
+@pytest.mark.slow
+def test_native_round_trips_under_sanitizers(tmp_path):
+    if sys.platform == "win32":
+        pytest.skip("bash build script; POSIX only")
+    out = tmp_path / "dcnn_sanitize_test"
+    proc = subprocess.run(
+        ["bash", _SCRIPT, "--run", str(out)],
+        capture_output=True, text=True, timeout=600)
+    tail = (proc.stdout + proc.stderr)[-4000:]
+    if proc.returncode == 2:
+        pytest.skip(f"no compiler / sanitizer runtime on this host: {tail}")
+    assert proc.returncode == 0, (
+        f"sanitized native round-trips failed (rc={proc.returncode}):\n"
+        f"{tail}")
+    assert "all round-trips clean" in proc.stdout

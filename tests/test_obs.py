@@ -32,7 +32,7 @@ import pytest
 
 import jax
 
-from dcnn_tpu.obs import (Counter, Gauge, Histogram, MetricsRegistry,
+from dcnn_tpu.obs import (MetricsRegistry,
                           configure, get_registry, get_tracer)
 from dcnn_tpu.obs.tracer import Tracer, _NULL_SPAN
 

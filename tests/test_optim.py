@@ -1,6 +1,5 @@
 """Optimizer + scheduler tests against reference semantics and torch."""
 
-import math
 
 import jax.numpy as jnp
 import numpy as np

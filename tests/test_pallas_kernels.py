@@ -4,7 +4,6 @@ Reference analog: kernel-vs-naive-reference comparison suites
 (SURVEY.md §4.2).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -6,7 +6,6 @@ import numpy as np
 import pytest
 
 from dcnn_tpu.core.mesh import make_mesh
-from dcnn_tpu.models import create_mnist_trainer
 from dcnn_tpu.nn import SequentialBuilder
 from dcnn_tpu.optim import SGD
 from dcnn_tpu.ops.losses import softmax_cross_entropy

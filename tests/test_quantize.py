@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from dcnn_tpu.nn import (
     QuantConv2DLayer, QuantDenseLayer, Sequential, SequentialBuilder,
-    layer_from_config, quantize_model,
+    quantize_model,
 )
 from dcnn_tpu.ops import conv2d, conv2d_int8
 from dcnn_tpu.ops import quant as quant_ops

@@ -4,12 +4,10 @@ Reference analog: ``sequential_residual_block_test.cpp``,
 ``layer_buffer_reuse_test.cpp`` and the MNIST trainer e2e (SURVEY.md §4.5).
 """
 
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dcnn_tpu.models import create_mnist_trainer, create_model
 from dcnn_tpu.nn import Sequential, SequentialBuilder

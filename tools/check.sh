@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# The one pre-merge gate: lint -> static analysis -> bench-gate self-test.
+#
+#   tools/check.sh            # run everything available, fail on any gate
+#
+# Stages:
+#   1. ruff (error-tier E/F rules, [tool.ruff] in pyproject.toml). Skipped
+#      with a notice when ruff is not installed — the container image does
+#      not ship it; the AST-level F-class issues are then still partially
+#      covered by stage 2's parse pass.
+#   2. python -m dcnn_tpu.analysis dcnn_tpu/ — the trace-safety /
+#      concurrency / atomicity suite against the committed baseline
+#      (docs/static_analysis.md). Zero unsuppressed findings required.
+#   3. benchmarks/compare.py --self-test — the bench regression gate's own
+#      fixture run (planted 25% drop must flag; clean history must pass).
+#
+# Tier-1 pytest is intentionally NOT chained here (it has its own runner
+# and budget); this script is the fast pre-merge loop.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== [1/3] ruff (E/F error tier) =="
+if command -v ruff >/dev/null 2>&1; then
+  if ! ruff check .; then
+    fail=1
+  fi
+else
+  echo "ruff not installed — skipped (pip install ruff to enable)"
+fi
+
+echo "== [2/3] dcnn_tpu.analysis =="
+if ! python -m dcnn_tpu.analysis dcnn_tpu/; then
+  fail=1
+fi
+
+echo "== [3/3] bench regression gate self-test =="
+if ! python benchmarks/compare.py --self-test; then
+  fail=1
+fi
+
+if [[ "$fail" != 0 ]]; then
+  echo "CHECK FAILED" >&2
+  exit 1
+fi
+echo "all checks passed"
