@@ -70,7 +70,10 @@ feed so the prep measurement exercises the full gather+augment+pack path
 (tuning guide: docs/performance.md), BENCH_FAULTS=1 for
 the checkpoint save/restore overhead probe (dcnn_tpu/resilience/; knob
 BENCH_FAULTS_REPS — emitted under a "resilience" key: sync save wall,
-async save's step-loop cost, verified-restore wall; docs/reliability.md).
+async save's step-loop cost, verified-restore wall, plus an "elastic"
+sub-block measuring a real kill-a-host recovery on a 2-peer loopback DP
+fleet: detection latency, checkpoint-restore wall, reconfiguration wall,
+optimizer steps lost; docs/reliability.md §"Elastic training").
 """
 
 from __future__ import annotations
@@ -760,6 +763,84 @@ def faults_section():
         "async_blocking_fraction": round(min(enqueue_s) / max(min(sync_s),
                                                               1e-9), 4),
         "restore_verified_s": round(min(restore_s), 4),
+        "elastic": elastic_subsection(),
+    }
+
+
+def elastic_subsection():
+    """The measured cost of surviving a host loss: a 2-peer in-process
+    elastic DP fleet over loopback (parallel/elastic.py), one peer killed
+    mid-epoch by a deterministic FaultPlan — reporting how long the
+    survivor took to notice (detection), how long the checkpoint restore
+    took, the whole reconfiguration wall, and how many optimizer steps
+    were lost (re-run) to the rewind."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel import comm
+    from dcnn_tpu.parallel.elastic import ElasticController, PeerSpec
+    from dcnn_tpu.resilience import FaultPlan
+    from dcnn_tpu.resilience.faults import InjectedCrash
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 32)).astype(np.float32)
+    y = one_hot(rng.integers(0, 8, 64), 8)
+
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(2)]
+    peers = [PeerSpec(i, "127.0.0.1", s.getsockname()[1])
+             for i, s in enumerate(socks)]
+    ctls, results = {}, {}
+    victim_plan = FaultPlan().arm("elastic.heartbeat", at=5,
+                                  exc=InjectedCrash)
+
+    with tempfile.TemporaryDirectory() as d:
+        def runner(i):
+            model = (SequentialBuilder("bench_elastic").input((32,))
+                     .dense(64).activation("relu").dense(8).build())
+            cfg = TrainingConfig(
+                epochs=2, learning_rate=0.05, seed=3, snapshot_dir=None,
+                elastic=True, elastic_microbatches=2,
+                elastic_timeout_s=20.0, elastic_heartbeat_s=0.0,
+                elastic_ckpt_steps=2, checkpoint_dir=d)
+            ctl = ElasticController(
+                model, SGD(0.05), "softmax_crossentropy",
+                ArrayDataLoader(x, y, batch_size=16, seed=7),
+                config=cfg, rank=i, peers=peers, listen_sock=socks[i],
+                fault_plan=victim_plan if i == 1 else None)
+            ctls[i] = ctl
+            try:
+                results[i] = ctl.fit(epochs=2)
+            except InjectedCrash:
+                results[i] = "crashed"
+
+        # daemon: if a controller wedges, the hung-fleet error must still
+        # let the bench process exit instead of blocking interpreter
+        # shutdown on a non-daemon join
+        threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        if any(t.is_alive() for t in threads):
+            return {"error": "elastic bench fleet hung"}
+
+    stats = ctls[0].stats
+    return {
+        "peers": 2,
+        "reconfigures": stats["reconfigures"],
+        "detection_s": round(max(stats["detection_s"] or [0.0]), 4),
+        "restore_wall_s": round(max(stats["restore_s"] or [0.0]), 4),
+        "reconfigure_wall_s": round(max(stats["reconfigure_s"] or [0.0]), 4),
+        "steps_lost": int(sum(stats["steps_lost"])),
+        "world_after": ctls[0].world,
+        "generation": ctls[0].gen,
     }
 
 

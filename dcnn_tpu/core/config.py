@@ -74,6 +74,33 @@ class TrainingConfig:
     stall_timeout_s: float = 0.0      # >0: StallWatchdog flags a hung
                                       # step/data fetch on the obs registry
 
+    # -- elastic data-parallel training (dcnn_tpu/parallel/elastic.py;
+    #    docs/reliability.md §"Elastic training") --
+    elastic: bool = False             # fit() runs the elastic DP controller:
+                                      # generation-stamped membership over
+                                      # the peer mesh, survives host loss
+                                      # mid-epoch via checkpoint-restore +
+                                      # batch-plan reshard
+    elastic_peers: str = ""           # "host:port,host:port,..." — one per
+                                      # host, rank = position (empty: solo)
+    elastic_rank: int = -1            # this host's rank (-1: PROCESS_ID env)
+    elastic_microbatches: int = 0     # global grad-accumulation grid K,
+                                      # fixed for the run; batch_size/K rows
+                                      # per microbatch (0: initial world
+                                      # size). The grid is re-partitioned —
+                                      # never re-gridded — across survivors,
+                                      # holding the global batch constant
+    elastic_heartbeat_s: float = 1.0  # background beat period (0: beats
+                                      # only ride the step loop)
+    elastic_timeout_s: float = 30.0   # peer silence before it is declared
+                                      # dead; also the frame-wait deadline
+    elastic_ckpt_steps: int = 0       # mid-epoch checkpoint cadence in
+                                      # optimizer steps (0: epoch boundaries
+                                      # only — a loss re-runs the epoch)
+    elastic_min_world: int = 1        # fewer survivors than this aborts
+                                      # (WorldCollapsedError) instead of
+                                      # limping on
+
     # -- external telemetry (dcnn_tpu/obs/server.py; docs/observability.md)
     metrics_port: int = -1            # >=0: serve /metrics + /healthz +
                                       # /snapshot over HTTP for the whole
@@ -111,6 +138,19 @@ class TrainingConfig:
             nonfinite_policy=get_env("NONFINITE_POLICY", base.nonfinite_policy),
             rollback_after=get_env("ROLLBACK_AFTER", base.rollback_after),
             stall_timeout_s=get_env("STALL_TIMEOUT_S", base.stall_timeout_s),
+            elastic=get_env("ELASTIC", base.elastic),
+            elastic_peers=get_env("ELASTIC_PEERS", base.elastic_peers),
+            elastic_rank=get_env("ELASTIC_RANK", base.elastic_rank),
+            elastic_microbatches=get_env("ELASTIC_MICROBATCHES",
+                                         base.elastic_microbatches),
+            elastic_heartbeat_s=get_env("ELASTIC_HEARTBEAT_S",
+                                        base.elastic_heartbeat_s),
+            elastic_timeout_s=get_env("ELASTIC_TIMEOUT_S",
+                                      base.elastic_timeout_s),
+            elastic_ckpt_steps=get_env("ELASTIC_CKPT_STEPS",
+                                       base.elastic_ckpt_steps),
+            elastic_min_world=get_env("ELASTIC_MIN_WORLD",
+                                      base.elastic_min_world),
             metrics_port=get_env("METRICS_PORT", base.metrics_port),
         )
 

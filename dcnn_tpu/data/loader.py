@@ -92,6 +92,49 @@ class BaseDataLoader:
         for start in range(0, stop, self.batch_size):
             yield idx[start:start + self.batch_size]
 
+    def shard_batch_indices(self, rank: int, world_size: int,
+                            rng: Optional[np.random.Generator] = None
+                            ) -> Iterator[np.ndarray]:
+        """One host's view of :meth:`batch_indices` in a ``world_size``-way
+        data-parallel group: for every global batch, the contiguous
+        ``batch_size / world_size`` slice belonging to ``rank``.
+
+        THE reshard definition (``parallel/elastic.py``, the pooled feed
+        planner): batch *membership and order* come from
+        :meth:`batch_indices` and depend only on (seed, epoch) — never on
+        the world size — so when an elastic reconfiguration re-derives the
+        plan with a new ``world_size``, the union of the per-host slices
+        is the identical global batch sequence and the optimizer sees the
+        same global batch at every step. Slices are contiguous so they
+        compose with the global gradient-accumulation microbatch grid
+        (``data_parallel.make_elastic_grad_step``): host shard boundaries
+        always fall on microbatch boundaries."""
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside world of {world_size}")
+        if self.batch_size % world_size:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"world_size {world_size}: the global batch could not be "
+                f"kept constant across hosts")
+        if not self.drop_last:
+            raise ValueError(
+                "shard_batch_indices requires drop_last=True: a ragged "
+                "tail batch cannot be split into equal host shares, so "
+                "the fixed-global-batch contract would silently break "
+                "on the last step of every epoch")
+        per = self.batch_size // world_size
+        for idx in self.batch_indices(rng):
+            yield idx[rank * per:(rank + 1) * per]
+
+    def rows(self, sel: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather raw ``(x, y)`` rows by index — the accessor shard
+        planners (elastic controller, feed-worker bridge) use instead of
+        reaching into ``_x``/``_y``."""
+        self._ensure_loaded()
+        return self._x[sel], self._y[sel]
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         rng = self.epoch_rng()
         for take in self.batch_indices(rng):

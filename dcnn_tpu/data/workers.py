@@ -184,6 +184,34 @@ def serial_shards(x: np.ndarray, y: np.ndarray, selections: Iterable, *,
         yield prepare_shard(x, y, sel, augment=augment, rng=rng)
 
 
+def host_shard_plan(loader, epoch: int, rank: int, world_size: int,
+                    start_step: int = 0):
+    """The world-size-parameterized selection plan for a
+    :class:`FeedWorkerPool` feeding ONE host of a data-parallel group:
+    this host's per-step row-index arrays for ``epoch``, starting at
+    global step ``start_step`` within the epoch.
+
+    Derived from ``BaseDataLoader.shard_batch_indices`` — the single
+    batch-order definition — so a reshard re-plans the pool by simply
+    calling this again with the new ``(rank, world_size)`` and the
+    restored ``start_step``: the union over hosts of the new plan is
+    bit-identical to the old global batch sequence, only the per-host
+    split moves. This is the *equal-split* view (requires
+    ``batch_size % world_size == 0``); the elastic controller
+    (``parallel/elastic.py``) derives its pool selections from the same
+    ``batch_indices`` plan via its microbatch-grid span instead, which
+    also covers uneven degraded worlds. Selections are materialized
+    (list) because the pool may be driven multiple times from the same
+    plan across a retry."""
+    loader.shuffle(epoch)
+    plan = [np.ascontiguousarray(sel, np.int64)
+            for sel in loader.shard_batch_indices(rank, world_size)]
+    if not 0 <= start_step <= len(plan):
+        raise ValueError(f"start_step {start_step} outside epoch of "
+                         f"{len(plan)} steps")
+    return plan[start_step:]
+
+
 # ---------------------------------------------------------------------------
 # zero-copy safety probe
 # ---------------------------------------------------------------------------

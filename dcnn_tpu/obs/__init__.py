@@ -53,11 +53,13 @@ import from any layer, including before backend selection.
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
-from .server import TelemetryServer, checkpoint_check, watchdog_check
+from .server import (TelemetryServer, checkpoint_check, elastic_check,
+                     watchdog_check)
 from .tracer import Tracer, configure, get_tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Tracer", "configure", "get_tracer",
     "TelemetryServer", "watchdog_check", "checkpoint_check",
+    "elastic_check",
 ]

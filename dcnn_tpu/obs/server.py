@@ -62,6 +62,8 @@ _HEALTH_FLAGS = (
     "train_stalled", "train_last_progress_age_s", "train_stall_flags_total",
     "train_skipped_steps_total", "train_rollbacks_total",
     "ckpt_last_step", "ckpt_saves_total", "ckpt_restore_skipped_total",
+    "elastic_generation", "elastic_world_size", "elastic_reconfiguring",
+    "elastic_reconfigures_total", "elastic_peers_lost_total",
 )
 
 
@@ -74,6 +76,24 @@ def watchdog_check(watchdog) -> Callable[[], Optional[str]]:
         if watchdog.check():
             return (f"stalled: no progress for > "
                     f"{watchdog.timeout_s:g}s")
+        return None
+    return _check
+
+
+def elastic_check(controller) -> Callable[[], Optional[str]]:
+    """Health check over an elastic controller
+    (``parallel/elastic.py``): degraded **while a reconfiguration is in
+    flight** — survivors are mid-barrier / restoring a checkpoint and the
+    replica is not serving useful steps, so a router or fleet scheduler
+    should treat it like a draining replica, not a dead one. Healthy
+    again the moment the new generation is established (the ``/healthz``
+    body's ``elastic_generation`` / ``elastic_world_size`` flags say what
+    it reconfigured *to*)."""
+    def _check() -> Optional[str]:
+        if getattr(controller, "reconfiguring", False):
+            return (f"elastic reconfiguration in flight "
+                    f"(generation {getattr(controller, 'generation', '?')}, "
+                    f"world {getattr(controller, 'world', '?')})")
         return None
     return _check
 
@@ -216,6 +236,12 @@ class TelemetryServer:
         if not any(n == "watchdog" for n, _ in self._checks):
             if flags.get("train_stalled"):
                 reasons.append("train_stalled: registry flag set")
+        # same contract for the elastic controller: a process that set the
+        # reconfiguring flag on the registry degrades even without the
+        # explicit elastic_check adapter registered
+        if not any(n == "elastic" for n, _ in self._checks):
+            if flags.get("elastic_reconfiguring"):
+                reasons.append("elastic_reconfiguring: registry flag set")
         ok = not reasons
         body = {
             "status": "ok" if ok else "unhealthy",

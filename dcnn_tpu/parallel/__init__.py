@@ -20,10 +20,25 @@ TPU-native design:
 - **Partitioners** (``partitioner.py``): naive even-layer split (reference
   ``NaivePartitioner``) plus the FLOP-balanced split the reference never
   implemented.
+- **Elastic data parallelism** (``elastic.py``): generation-stamped
+  membership/heartbeat over the comm framing + a reconfiguration protocol
+  that survives losing a host mid-epoch — checkpoint-restore the
+  survivors, re-shard the batch plan over the new world size with the
+  global batch held constant, continue (docs/reliability.md §"Elastic
+  training"). The capability the reference's static
+  dies-with-its-weakest-worker pipeline fundamentally lacks.
 """
 
 from .partitioner import FlopBalancedPartitioner, NaivePartitioner, Partitioner
-from .data_parallel import make_data_parallel_train_step, shard_batch, replicate
+from .data_parallel import (
+    make_data_parallel_train_step, make_elastic_apply_step,
+    make_elastic_grad_step, shard_batch, replicate,
+)
+from .elastic import (
+    ElasticController, EvictedError, Membership, PeerSpec,
+    WorldCollapsedError, microbatch_span, parse_peers,
+)
+from .multihost import PeerLostError
 from .pipeline import (
     InProcessPipelineCoordinator, PipelineError, PipelineStage,
     train_pipeline_batch_sync,
@@ -46,6 +61,9 @@ from .worker import StageWorker, run_worker
 __all__ = [
     "Partitioner", "NaivePartitioner", "FlopBalancedPartitioner",
     "make_data_parallel_train_step", "shard_batch", "replicate",
+    "make_elastic_grad_step", "make_elastic_apply_step",
+    "ElasticController", "Membership", "PeerSpec", "PeerLostError",
+    "EvictedError", "WorldCollapsedError", "microbatch_span", "parse_peers",
     "PipelineStage", "InProcessPipelineCoordinator", "PipelineError",
     "train_pipeline_batch_sync",
     "HeteroCompiledPipeline", "SequentialStageStack",

@@ -75,17 +75,31 @@ class Channel:
         self._sock = sock
         self._send_lock = threading.Lock()
         self.compress = compress
+        # set once sendall has raised: part of a frame may already be on
+        # the wire, so the byte stream is unframeable — every later send
+        # must fail fast rather than interleave a fresh frame
+        self._broken = False
 
     # -- send --
     def send(self, cmd: str, meta: Optional[Dict[str, Any]] = None,
              array: Optional[np.ndarray] = None,
-             raw: Optional[bytes] = None) -> None:
+             raw: Optional[bytes] = None, *,
+             attempts: int = 3, retry_timeout: float = 2.0,
+             sleep=time.sleep, clock=time.monotonic) -> None:
+        """Send one frame, riding the shared bounded-backoff primitive
+        (``resilience/retry.py``) like :func:`connect` — a transient
+        pre-wire failure (the armed ``comm.send`` fault point, an
+        ``ENOBUFS``-class hiccup) is retried with jittered exponential
+        backoff under a ``retry_timeout`` deadline instead of aborting a
+        reconfiguration mid-protocol.
+
+        Retries stop the moment any bytes may have reached the wire: a
+        failed ``sendall`` marks the channel broken (partial frame =
+        unframeable stream) and the error surfaces immediately — resend
+        semantics then belong to the caller's reconnect/reconfigure
+        layer, never to this socket."""
         m = dict(meta or {})
         m["cmd"] = cmd
-        # fault-injection point: an armed "comm.send" drops this frame on
-        # the floor (OSError), exercising the coordinator's abort/retry
-        # paths without a real network fault
-        _faults.trip("comm.send", cmd=cmd)
         payload = b""
         if array is not None:
             payload = _CODEC.compress_array(
@@ -97,8 +111,31 @@ class Channel:
         mb = json.dumps(m).encode()
         flags = _FLAG_PAYLOAD if payload else 0
         header = _HEADER.pack(MAGIC, flags, len(mb), len(payload))
-        with self._send_lock:
-            self._sock.sendall(header + mb + payload)
+        frame = header + mb + payload
+
+        def attempt() -> None:
+            # fault-injection point: an armed "comm.send" fails this
+            # attempt pre-wire (OSError drives the backoff path; an
+            # InjectedFault/InjectedCrash surfaces uncaught — the
+            # dead-mid-send simulation)
+            _faults.trip("comm.send", cmd=cmd)
+            with self._send_lock:
+                if self._broken:
+                    raise ChannelClosed(
+                        "channel broken by an earlier partial send")
+                try:
+                    self._sock.sendall(frame)
+                except OSError:
+                    self._broken = True
+                    raise
+
+        if attempts <= 1:
+            attempt()
+            return
+        retry_call(attempt, attempts=attempts, base=0.05, cap=0.5,
+                   timeout=retry_timeout, retry_on=(OSError,),
+                   retry_if=lambda e: not self._broken,
+                   sleep=sleep, clock=clock, name="comm_send")
 
     # -- recv (blocking, one frame) --
     def recv(self) -> Tuple[str, Dict[str, Any], Any]:

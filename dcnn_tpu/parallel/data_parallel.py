@@ -42,6 +42,92 @@ def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS, spatial_axis: Optional
     return jax.tree_util.tree_map(put, batch)
 
 
+def make_elastic_grad_step(model: Sequential, loss_fn: Callable,
+                           num_microbatches: int, jit: bool = True):
+    """The gradient half of a host-level data-parallel step:
+    ``gstep(params, state, x, y, rng, mb0) -> (grad_sum, state_final,
+    loss_sum)`` with NO optimizer update — the update happens after a
+    cross-host gradient exchange (``parallel/elastic.py``), which is why
+    this cannot reuse the fused :func:`~dcnn_tpu.train.trainer.make_train_step`.
+
+    The batch ``x`` is this host's contiguous slice of the *global*
+    microbatch grid: ``num_microbatches`` local microbatches whose global
+    indices start at ``mb0`` (a traced scalar, so a reshard that changes
+    this host's position re-dispatches without retracing; only a change
+    in the local microbatch *count* recompiles). Per-microbatch dropout
+    rng is ``fold_in(rng, global_mb_index)`` — world-size independent, so
+    the same global microbatch sees the same rng stream no matter which
+    host runs it after a reshard.
+
+    Returns **sums**, not means: ``grad_sum`` is the sum of per-microbatch
+    mean-gradients and ``loss_sum`` the sum of per-microbatch mean-losses,
+    so the reduce side can divide once by the *global* microbatch count K
+    and get the exact global mean even when hosts carry unequal microbatch
+    counts (K not divisible by the surviving world size). ``state_final``
+    is the layer state threaded sequentially through the local
+    microbatches (same semantics as ``make_train_step``'s accumulation
+    scan); the exchange averages it across hosts weighted by microbatch
+    count — exact for linear-EMA state (BN running stats), documented
+    approximation otherwise."""
+    import jax.numpy as jnp
+
+    from ..ops.losses import upcast_logits
+
+    def forward_loss(params, state, x, y, rng):
+        logits, new_state = model.apply(params, state, x, training=True,
+                                        rng=rng)
+        logits = upcast_logits(logits)
+        return loss_fn(logits, y), new_state
+
+    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+
+    def gstep(params, state, x, y, rng, mb0):
+        if x.shape[0] % num_microbatches:
+            raise ValueError(
+                f"host batch of {x.shape[0]} rows not divisible by "
+                f"{num_microbatches} local microbatches — the global "
+                f"microbatch grid must evenly tile every host share")
+        if num_microbatches == 1:
+            (loss, new_state), grads = grad_fn(params, state, x, y,
+                                               jax.random.fold_in(rng, mb0))
+            return grads, new_state, loss
+        mb = x.shape[0] // num_microbatches
+        xs = x.reshape(num_microbatches, mb, *x.shape[1:])
+        ys = y.reshape(num_microbatches, mb, *y.shape[1:])
+
+        def body(carry, sl):
+            st, grad_acc, loss_acc = carry
+            xi, yi, m = sl
+            (loss, new_st), grads = grad_fn(params, st, xi, yi,
+                                            jax.random.fold_in(rng, m))
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            return (new_st, grad_acc, loss_acc + loss), None
+
+        zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+        ms = mb0 + jnp.arange(num_microbatches)
+        (new_state, grad_sum, loss_sum), _ = jax.lax.scan(
+            body, (state, zero, jnp.zeros(())), (xs, ys, ms))
+        return grad_sum, new_state, loss_sum
+
+    return jax.jit(gstep) if jit else gstep
+
+
+def make_elastic_apply_step(optimizer: Optimizer):
+    """The update half: ``apply(params, opt_state, grads, lr) ->
+    (new_params, new_opt_state)``, jitted once per optimizer. Every
+    surviving host applies this to the SAME broadcast gradient bytes, so
+    replicated params/opt-state stay bit-identical across hosts without a
+    parameter broadcast."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def apply(params, opt_state, grads, lr):
+        return optimizer.update(grads, opt_state, params,
+                                jnp.asarray(lr, jnp.float32))
+
+    return apply
+
+
 def make_data_parallel_train_step(model: Sequential, loss_fn: Callable,
                                   optimizer: Optimizer, mesh: Mesh,
                                   num_microbatches: int = 1,

@@ -96,23 +96,69 @@ def _kv_client():
     return client
 
 
+class PeerLostError(RuntimeError):
+    """A peer failed to show up for a collective control-plane operation
+    (barrier, config exchange, gradient exchange) within its deadline — or
+    its connection died outright.
+
+    This is the typed boundary between "a peer is slow" and "a peer is
+    gone": :func:`barrier` / :func:`broadcast_config` raise it instead of
+    leaking the distributed runtime's internal timeout error, and the
+    elastic controller (``parallel/elastic.py``) treats it as the signal
+    to start a reconfiguration rather than hang or crash."""
+
+    def __init__(self, op: str, detail: str = "",
+                 peers: Optional[list] = None):
+        self.op = op
+        self.peers = list(peers) if peers else []
+        who = f" (peers {self.peers})" if self.peers else ""
+        super().__init__(f"peer lost during {op}{who}"
+                         + (f": {detail}" if detail else ""))
+
+
 def broadcast_config(key: str, config: Dict[str, Any],
-                     timeout_ms: int = 60_000) -> Dict[str, Any]:
+                     timeout_ms: int = 60_000, *,
+                     client=None) -> Dict[str, Any]:
     """Coordinator publishes a JSON config; workers block until it lands.
 
     Replaces the reference's CONFIG_TRANSFER message + CONFIG_RECEIVED ack
     (``coordinator.hpp:557-571``): the kv-store get is the ack. Typical use:
     process 0 publishes each worker's stage model JSON
-    (``Sequential.get_config()``), workers rebuild via the LayerFactory."""
-    client = _kv_client()
+    (``Sequential.get_config()``), workers rebuild via the LayerFactory.
+
+    The wait is explicitly deadline-bounded: a coordinator that never
+    publishes (crashed during startup) surfaces as a typed
+    :class:`PeerLostError` after ``timeout_ms``, not as whatever the
+    distributed runtime's kv client raises that day. ``client`` is
+    injectable for tests (defaults to the live jax kv store)."""
+    client = client if client is not None else _kv_client()
     if is_coordinator():
         client.key_value_set(key, json.dumps(config))
         return config
-    blob = client.blocking_key_value_get(key, timeout_ms)
+    try:
+        blob = client.blocking_key_value_get(key, timeout_ms)
+    except Exception as e:
+        raise PeerLostError(
+            f"broadcast_config({key!r})",
+            f"coordinator did not publish within {timeout_ms}ms "
+            f"({type(e).__name__}: {e})") from e
     return json.loads(blob)
 
 
-def barrier(name: str, timeout_ms: int = 60_000) -> None:
+def barrier(name: str, timeout_ms: int = 60_000, *, client=None) -> None:
     """Cross-process barrier (the reference reserved BARRIER_SYNC but never
-    implemented it, ``command_type.hpp:52`` — implemented here)."""
-    _kv_client().wait_at_barrier(name, timeout_ms)
+    implemented it, ``command_type.hpp:52`` — implemented here).
+
+    Deadline-bounded with a typed error: a peer that never arrives —
+    preempted host, wedged process — turns into :class:`PeerLostError`
+    after ``timeout_ms`` instead of the runtime-default behavior of
+    hanging the surviving processes. ``client`` is injectable for
+    tests."""
+    client = client if client is not None else _kv_client()
+    try:
+        client.wait_at_barrier(name, timeout_ms)
+    except Exception as e:
+        raise PeerLostError(
+            f"barrier({name!r})",
+            f"not all peers arrived within {timeout_ms}ms "
+            f"({type(e).__name__}: {e})") from e

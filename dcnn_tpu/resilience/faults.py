@@ -38,10 +38,21 @@ Trip points wired in this PR (grep for ``faults.trip`` to enumerate):
                                 at shard ``at=i``
 ``train.nonfinite_input``       poison the training batch at global step
                                 ``at=j`` so the loss/grads go non-finite
-``comm.send``                   drop (raise ``OSError`` from) a pipeline
-                                channel send
+``comm.send``                   fail a channel send attempt pre-wire (drives
+                                the send backoff/retry path; armed with
+                                ``exc=InjectedCrash`` it is the "host died
+                                mid-send" simulation)
 ``comm.connect``                fail a connection attempt (drives the
                                 backoff/retry path)
+``elastic.heartbeat``           raise in the elastic controller's beat path
+                                at beat ``at=k`` — armed with
+                                ``exc=InjectedCrash`` this IS the
+                                kill-a-host-mid-epoch simulation
+                                (``parallel/elastic.py``)
+``elastic.reconfigure``         raise at reconfiguration entry — armed with
+                                ``exc=InjectedCrash`` on a *second* peer it
+                                proves a loss during recovery is survived
+                                (reconfigure idempotence)
 ==============================  ==============================================
 
 This module is stdlib-only and import-safe from any layer.
@@ -130,6 +141,15 @@ class FaultPlan:
         if issubclass(exc, InjectedFault):
             raise exc(point, n, **context)
         raise exc(f"injected fault at {point!r} (invocation {n})")
+
+    def trip(self, point: str, **context) -> None:
+        """Per-plan trip: check THIS plan (not the process-global one).
+
+        Multi-peer simulations (``parallel/elastic.py`` tests run several
+        in-process peers) arm one plan per victim and hand it to that
+        peer's controller — the global :func:`install` slot would fault
+        every peer at once."""
+        self._check(point, context)
 
     # -- corruption utility (not a trip point: tests call it directly) --
     def bit_flip(self, path: str) -> Tuple[int, int]:

@@ -536,6 +536,18 @@ class Trainer:
     def fit(self, ts: TrainState, train_loader, val_loader=None,
             epochs: Optional[int] = None, seed: Optional[int] = None) -> TrainState:
         cfg = self.config
+        if cfg.elastic:
+            # generation-aware elastic DP fit: the membership/heartbeat
+            # layer, lockstep gradient exchange, and the
+            # reconfiguration-on-peer-loss protocol live in
+            # parallel/elastic.py; this loop delegates so a single config
+            # knob (ELASTIC=1 + ELASTIC_PEERS) turns a normal run into
+            # one that survives losing a host mid-epoch. Lazy import:
+            # train.trainer must stay importable without the parallel
+            # package (which itself imports this module).
+            from ..parallel.elastic import elastic_fit
+            return elastic_fit(self, ts, train_loader, val_loader, epochs,
+                               seed=seed)
         epochs = epochs or cfg.epochs
         rng = jax.random.PRNGKey(seed if seed is not None else cfg.seed)
         best_val = -1.0

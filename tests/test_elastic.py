@@ -1,0 +1,628 @@
+"""Elastic preemption-tolerant DP training (ISSUE 8).
+
+The headline contract, proven in-process: N controllers on threads over
+real loopback sockets (the same topology ``tests/test_pipeline_failures``
+uses for the pipeline), one killed mid-epoch by a deterministic per-peer
+FaultPlan — survivors detect the loss, barrier on a new generation,
+restore the newest checkpoint, re-shard the batch plan over the new world
+size, and finish with final params matching a never-interrupted
+fixed-world run within FP-reassociation tolerance, the global batch
+identical pre/post reshard.
+"""
+
+import tempfile
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from dcnn_tpu.core.config import TrainingConfig
+from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.optim import SGD
+from dcnn_tpu.parallel import comm
+from dcnn_tpu.parallel.elastic import (
+    ElasticController, EvictedError, PeerSpec, WorldCollapsedError,
+    microbatch_span, parse_peers)
+from dcnn_tpu.parallel.multihost import PeerLostError
+from dcnn_tpu.resilience import FaultPlan
+from dcnn_tpu.resilience.faults import InjectedCrash
+
+_rng = np.random.default_rng(0)
+X = _rng.normal(size=(48, 16)).astype(np.float32)
+Y = one_hot(_rng.integers(0, 4, 48), 4)
+BATCH = 12  # 4 global steps/epoch over the 48 rows
+
+RTOL, ATOL = 2e-4, 2e-5  # FP reassociation of the gradient sum only
+
+
+def _model():
+    # stateless layers only: BN batch statistics are documented as
+    # approximately (not bit-) preserved across a reshard, so the
+    # exactness contract is proven on a state-free model
+    return (SequentialBuilder("elastic_model").input((16,))
+            .dense(32).activation("relu").dense(4).build())
+
+
+def _loader():
+    return ArrayDataLoader(X, Y, batch_size=BATCH, seed=7)
+
+
+def _run_fleet(n, *, epochs=3, faults=None, ckpt_dir=None, ckpt_steps=2,
+               k=2, min_world=1):
+    """N in-process peers over loopback; returns (controllers, results)
+    where a result is a TrainState, the string "crashed" (simulated host
+    death), or the raised exception."""
+    faults = faults or {}
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(n)]
+    peers = [PeerSpec(i, "127.0.0.1", s.getsockname()[1])
+             for i, s in enumerate(socks)]
+    ctls, results = {}, {}
+
+    def runner(i):
+        cfg = TrainingConfig(
+            epochs=epochs, learning_rate=0.05, seed=3, snapshot_dir=None,
+            elastic=True, elastic_microbatches=k, elastic_timeout_s=15.0,
+            elastic_heartbeat_s=0.0, elastic_ckpt_steps=ckpt_steps,
+            elastic_min_world=min_world, checkpoint_dir=ckpt_dir)
+        ctl = ElasticController(
+            _model(), SGD(0.05), "softmax_crossentropy", _loader(),
+            config=cfg, rank=i, peers=peers, listen_sock=socks[i],
+            fault_plan=faults.get(i))
+        ctls[i] = ctl
+        try:
+            results[i] = ctl.fit(epochs=epochs)
+        except InjectedCrash:
+            results[i] = "crashed"
+        except Exception as e:  # surfaced to the asserting test
+            results[i] = e
+
+    threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "elastic fleet hung"
+    return ctls, results
+
+
+def _leaves(ts):
+    return jax.tree_util.tree_leaves(jax.device_get(ts.params))
+
+
+@pytest.fixture(scope="module")
+def baseline2():
+    """Never-interrupted fixed-world run: 2 peers, K=2."""
+    ctls, results = _run_fleet(2, k=2)
+    # replicated params are BIT-identical across peers (the mean is
+    # computed once on the leader and broadcast)
+    for a, b in zip(_leaves(results[0]), _leaves(results[1])):
+        np.testing.assert_array_equal(a, b)
+    return _leaves(results[0]), ctls[0]
+
+
+@pytest.fixture(scope="module")
+def baseline3():
+    """Never-interrupted fixed-world run: 3 peers, K=6."""
+    _ctls, results = _run_fleet(3, k=6)
+    return _leaves(results[0])
+
+
+# ---------------------------------------------------------------------------
+# plan / grid unit coverage
+# ---------------------------------------------------------------------------
+
+def test_microbatch_span_partitions_every_world():
+    for total in (1, 2, 3, 6, 8):
+        for world in range(1, total + 1):
+            owned = []
+            for p in range(world):
+                lo, hi = microbatch_span(total, world, p)
+                owned.extend(range(lo, hi))
+            assert owned == list(range(total)), (total, world)
+
+
+def test_shard_batch_indices_union_is_the_global_plan():
+    loader = _loader()
+    loader.shuffle(5)
+    ref = [np.asarray(b) for b in loader.batch_indices()]
+    for world in (1, 2, 3, 4, 6):
+        shards = []
+        for r in range(world):
+            loader.shuffle(5)
+            shards.append(list(loader.shard_batch_indices(r, world)))
+        for bi, batch in enumerate(ref):
+            got = np.concatenate([shards[r][bi] for r in range(world)])
+            np.testing.assert_array_equal(got, batch)
+
+
+def test_shard_batch_indices_validation():
+    loader = _loader()
+    with pytest.raises(ValueError, match="divisible"):
+        list(loader.shard_batch_indices(0, 5))  # 12 % 5 != 0
+    with pytest.raises(ValueError, match="outside world"):
+        list(loader.shard_batch_indices(2, 2))
+    ragged = ArrayDataLoader(X, Y, batch_size=12, seed=7, drop_last=False)
+    with pytest.raises(ValueError, match="drop_last"):
+        list(ragged.shard_batch_indices(0, 2))
+
+
+def test_host_shard_plan_drives_feed_pool_bit_identically():
+    from dcnn_tpu.data.workers import FeedWorkerPool, host_shard_plan
+
+    loader = _loader()
+    plan = host_shard_plan(loader, epoch=2, rank=1, world_size=2)
+    loader.shuffle(2)
+    ref = list(loader.shard_batch_indices(1, 2))
+    assert len(plan) == len(ref)
+    for a, b in zip(plan, ref):
+        np.testing.assert_array_equal(a, b)
+    # a reconfiguration re-plans by re-calling with the new world size,
+    # resuming at the restored step
+    replanned = host_shard_plan(loader, epoch=2, rank=0, world_size=1,
+                                start_step=2)
+    loader.shuffle(2)
+    full = [np.asarray(b) for b in loader.batch_indices()]
+    for got, want in zip(replanned, full[2:]):
+        np.testing.assert_array_equal(got, want)
+    # and the pool's serial path gathers exactly the planned rows
+    pool = FeedWorkerPool(X, Y, max_rows=BATCH, num_workers=0)
+    for sel, shard in zip(plan, pool.shards(iter(plan), epoch=2)):
+        xg, yg = shard.for_put()
+        np.testing.assert_array_equal(xg, X[sel])
+        np.testing.assert_array_equal(yg, Y[sel])
+        shard.release()
+
+
+def test_parse_peers():
+    peers = parse_peers("10.0.0.1:5000, 10.0.0.2:5001,:5002")
+    assert peers == [PeerSpec(0, "10.0.0.1", 5000),
+                     PeerSpec(1, "10.0.0.2", 5001),
+                     PeerSpec(2, "127.0.0.1", 5002)]
+
+
+# ---------------------------------------------------------------------------
+# the headline: kill a host mid-epoch
+# ---------------------------------------------------------------------------
+
+def test_solo_elastic_is_deterministic():
+    _c1, r1 = _run_fleet(1, k=2)
+    _c2, r2 = _run_fleet(1, k=2)
+    for a, b in zip(_leaves(r1[0]), _leaves(r2[0])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_kill_a_host_mid_epoch_params_match_uninterrupted(baseline2):
+    base_params, base_ctl = baseline2
+    with tempfile.TemporaryDirectory() as d:
+        plan = FaultPlan().arm("elastic.heartbeat", at=6, exc=InjectedCrash)
+        ctls, results = _run_fleet(2, faults={1: plan}, ckpt_dir=d)
+    assert results[1] == "crashed"
+    survivor = ctls[0]
+    assert not isinstance(results[0], BaseException), results[0]
+    # reconfigured exactly once, world 2 -> 1, a fresh generation
+    assert survivor.stats["reconfigures"] == 1
+    assert survivor.gen == 1 and survivor.world == 1
+    # the global batch is identical pre/post reshard: every executed
+    # optimizer step — before the kill at world 2 and after at world 1 —
+    # consumed exactly the loader's global batch
+    rows = {e["global_rows"] for e in survivor.step_log}
+    assert rows == {BATCH}
+    worlds = {e["world"] for e in survivor.step_log}
+    assert worlds == {1, 2}
+    # final params match the never-interrupted fixed-world run
+    for a, b in zip(base_params, _leaves(results[0])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+    # and the uninterrupted baseline saw the full 12 steps while the
+    # survivor re-ran the rewound ones
+    assert len(base_ctl.step_log) == 12
+    assert [e["gs"] for e in survivor.step_log][-1] == 12
+
+
+def test_kill_the_leader_survivor_takes_over(baseline2):
+    base_params, _ = baseline2
+    with tempfile.TemporaryDirectory() as d:
+        plan = FaultPlan().arm("elastic.heartbeat", at=6, exc=InjectedCrash)
+        ctls, results = _run_fleet(2, faults={0: plan}, ckpt_dir=d)
+    assert results[0] == "crashed"
+    new_leader = ctls[1]
+    assert not isinstance(results[1], BaseException), results[1]
+    assert new_leader.gen == 1 and new_leader.world == 1
+    assert new_leader.is_leader()
+    for a, b in zip(base_params, _leaves(results[1])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+def test_second_loss_during_recovery_is_survived(baseline3):
+    """Reconfigure idempotence: peer 2 is killed mid-epoch; peer 1 is
+    armed to die at reconfiguration entry — the leader's first recovery
+    wave fails and the protocol re-enters with the shrunken survivor
+    set."""
+    with tempfile.TemporaryDirectory() as d:
+        plans = {
+            2: FaultPlan().arm("elastic.heartbeat", at=5,
+                               exc=InjectedCrash),
+            1: FaultPlan().arm("elastic.reconfigure", exc=InjectedCrash),
+        }
+        ctls, results = _run_fleet(3, faults=plans, ckpt_dir=d, k=6)
+    assert results[2] == "crashed" and results[1] == "crashed"
+    leader = ctls[0]
+    assert not isinstance(results[0], BaseException), results[0]
+    # two reconfiguration waves collapsed into one completed recovery at
+    # generation 2 (gen 1 never established — its barrier lost a peer)
+    assert leader.gen == 2 and leader.world == 1
+    assert leader.stats["peers_lost"] == 2
+    for a, b in zip(baseline3, _leaves(results[0])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+def test_uneven_degraded_grid_keeps_global_batch(baseline3_k3):
+    """K=3 microbatches over 2 survivors: unequal host shares (2+1
+    microbatches) must still sum to the exact global batch — the
+    weighted gradient-sum path."""
+    with tempfile.TemporaryDirectory() as d:
+        plans = {1: FaultPlan().arm("elastic.heartbeat", at=5,
+                                    exc=InjectedCrash)}
+        ctls, results = _run_fleet(3, faults=plans, ckpt_dir=d, k=3)
+    assert results[1] == "crashed"
+    for r in (0, 2):
+        assert not isinstance(results[r], BaseException), results[r]
+        assert ctls[r].world == 2
+    # survivors stay bit-identical to each other even with unequal shares
+    for a, b in zip(_leaves(results[0]), _leaves(results[2])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(baseline3_k3, _leaves(results[0])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+@pytest.fixture(scope="module")
+def baseline3_k3():
+    _ctls, results = _run_fleet(3, k=3)
+    return _leaves(results[0])
+
+
+def test_evicted_peer_exits_instead_of_fighting_the_quorum():
+    """A peer the surviving quorum timed out joins the RECONF it receives
+    as a follower — and finding itself outside the survivor list, raises
+    EvictedError rather than escalating generations against hosts that
+    already moved on."""
+    cfg = TrainingConfig(
+        elastic=True, elastic_microbatches=2, elastic_heartbeat_s=0.0,
+        snapshot_dir=None)
+    ctl = ElasticController(
+        _model(), SGD(0.05), "softmax_crossentropy", _loader(),
+        config=cfg, rank=1,
+        peers=[PeerSpec(0, "127.0.0.1", 0), PeerSpec(1, "127.0.0.1", 0)])
+    with pytest.raises(EvictedError, match="excluded from generation 5"):
+        ctl._join_reconf({"gen": 5, "survivors": [0], "ckpt_step": -1,
+                          "lr": 0.05})
+
+
+def test_min_world_floor_aborts_instead_of_limping():
+    with tempfile.TemporaryDirectory() as d:
+        plan = FaultPlan().arm("elastic.heartbeat", at=6, exc=InjectedCrash)
+        _ctls, results = _run_fleet(2, faults={1: plan}, ckpt_dir=d,
+                                    min_world=2)
+    assert results[1] == "crashed"
+    assert isinstance(results[0], WorldCollapsedError)
+
+
+# ---------------------------------------------------------------------------
+# membership liveness (fake clock, no sockets)
+# ---------------------------------------------------------------------------
+
+def test_membership_timeout_detection_fake_clock():
+    from dcnn_tpu.obs.registry import MetricsRegistry
+    from dcnn_tpu.parallel.elastic import Membership
+
+    t = [0.0]
+    reg = MetricsRegistry()
+    m = Membership(0, [PeerSpec(0, "h", 1), PeerSpec(1, "h", 2)],
+                   peer_timeout_s=5.0, clock=lambda: t[0], registry=reg)
+
+    class FakeChan:
+        def close(self):
+            pass
+
+    with m._lock:
+        m._channels[1] = FakeChan()
+        m._last_heard[1] = t[0]
+    assert m.check_peers() == []
+    t[0] = 4.0
+    m.heard(1)
+    t[0] = 8.9  # 4.9s silent — under the timeout
+    assert m.check_peers() == []
+    assert m.alive() == [0, 1]
+    t[0] = 9.1  # 5.1s silent
+    assert m.check_peers() == [1]
+    assert m.alive() == [0]
+    dets = m.pop_detections()
+    assert len(dets) == 1
+    rank, age = dets[0]
+    assert rank == 1 and age == pytest.approx(5.1)
+    assert reg.counter("elastic_peers_lost_total").value == 1
+    # edge-triggered: already-dead peers are not re-flagged
+    t[0] = 20.0
+    assert m.check_peers() == []
+
+
+def test_membership_beat_thread_lifecycle():
+    from dcnn_tpu.parallel.elastic import Membership
+
+    m = Membership(0, [PeerSpec(0, "h", 1)], heartbeat_s=0.01)
+    m._start_beat_thread()
+    assert m._hb_thread is not None and m._hb_thread.is_alive()
+    m.close()
+    assert m._hb_thread is None
+    m.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# multihost satellite: typed PeerLostError instead of hanging/leaking
+# ---------------------------------------------------------------------------
+
+class _FakeKv:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.store = {}
+        self.barriers = []
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if self.fail:
+            raise RuntimeError(f"Deadline Exceeded after {timeout_ms}ms")
+        return self.store[key]
+
+    def wait_at_barrier(self, name, timeout_ms):
+        if self.fail:
+            raise RuntimeError(f"Barrier timed out after {timeout_ms}ms")
+        self.barriers.append(name)
+
+
+def test_multihost_barrier_raises_typed_peer_lost(monkeypatch):
+    from dcnn_tpu.parallel import multihost
+
+    kv = _FakeKv(fail=True)
+    with pytest.raises(PeerLostError, match=r"barrier\('epoch-1'\)"):
+        multihost.barrier("epoch-1", timeout_ms=10, client=kv)
+    kv_ok = _FakeKv()
+    multihost.barrier("epoch-1", timeout_ms=10, client=kv_ok)
+    assert kv_ok.barriers == ["epoch-1"]
+
+
+def test_multihost_broadcast_config_raises_typed_peer_lost(monkeypatch):
+    from dcnn_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost.jax, "process_index", lambda: 1)
+    kv = _FakeKv(fail=True)
+    with pytest.raises(PeerLostError, match="broadcast_config"):
+        multihost.broadcast_config("cfg", {"a": 1}, timeout_ms=10,
+                                   client=kv)
+    # coordinator publishes; worker receives
+    monkeypatch.setattr(multihost.jax, "process_index", lambda: 0)
+    kv_ok = _FakeKv()
+    assert multihost.broadcast_config("cfg", {"a": 1}, client=kv_ok) \
+        == {"a": 1}
+    monkeypatch.setattr(multihost.jax, "process_index", lambda: 1)
+    assert multihost.broadcast_config("cfg", {}, client=kv_ok) == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# comm satellite: the send path rides the shared retry primitive
+# ---------------------------------------------------------------------------
+
+def _channel_pair():
+    srv = comm.listen(0, host="127.0.0.1")
+    tx = comm.connect("127.0.0.1", srv.getsockname()[1], timeout=5.0)
+    sock, _ = srv.accept()
+    srv.close()
+    return tx, comm.Channel(sock)
+
+
+def test_send_retries_flaky_fault_then_delivers():
+    """ISSUE 8 satellite: a transient pre-wire send failure (the armed
+    comm.send fault point) is retried with backoff, not fatal — the frame
+    arrives intact and the attempts are visible on the registry."""
+    from dcnn_tpu.obs import get_registry
+
+    tx, rx = _channel_pair()
+    try:
+        reg = get_registry()
+        before = reg.counter("comm_send_retry_attempts_total").value
+        with FaultPlan().arm("comm.send", times=2, exc=OSError) as plan:
+            tx.send("PING", {"n": 7}, array=np.arange(4, dtype=np.float32),
+                    attempts=4, sleep=lambda s: None)
+            assert plan.count("comm.send") == 3
+        cmd, meta, payload = rx.recv()
+        assert cmd == "PING" and meta["n"] == 7
+        np.testing.assert_array_equal(payload,
+                                      np.arange(4, dtype=np.float32))
+        assert reg.counter("comm_send_retry_attempts_total").value \
+            == before + 2
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_send_exhausted_retries_reraise():
+    tx, rx = _channel_pair()
+    try:
+        with FaultPlan().arm("comm.send", exc=OSError):
+            with pytest.raises(OSError):
+                tx.send("PING", {}, attempts=3, sleep=lambda s: None)
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_send_on_broken_socket_fails_fast_not_retried():
+    """Once sendall has raised, part of a frame may be on the wire: the
+    channel marks itself broken and every later send fails immediately —
+    resend-after-reconnect is the caller's job, never this socket's."""
+    tx, rx = _channel_pair()
+    rx.close()
+    big = np.zeros(1 << 20, dtype=np.float32)  # overflow the socket buffer
+    slept = []
+    with pytest.raises(OSError):
+        for _ in range(64):
+            tx.send("DATA", {}, array=big, attempts=3,
+                    sleep=lambda s: slept.append(s))
+    assert tx._broken
+    assert slept == []  # the broken path never backed off
+    with pytest.raises(comm.ChannelClosed):
+        tx.send("DATA", {}, attempts=3, sleep=lambda s: None)
+    tx.close()
+
+
+def test_injected_crash_on_send_is_not_retried():
+    tx, rx = _channel_pair()
+    try:
+        with FaultPlan().arm("comm.send", exc=InjectedCrash):
+            with pytest.raises(InjectedCrash):
+                tx.send("PING", {}, attempts=5, sleep=lambda s: None)
+    finally:
+        tx.close()
+        rx.close()
+
+
+# ---------------------------------------------------------------------------
+# obs satellite: /healthz degrades while reconfiguring
+# ---------------------------------------------------------------------------
+
+def test_healthz_degrades_while_reconfiguring():
+    from dcnn_tpu.obs import TelemetryServer, elastic_check
+    from dcnn_tpu.obs.registry import MetricsRegistry
+    from dcnn_tpu.obs.tracer import Tracer
+
+    class FakeController:
+        reconfiguring = False
+        generation = 3
+        world = 2
+
+    ctl = FakeController()
+    srv = TelemetryServer(registry=MetricsRegistry(), tracer=Tracer())
+    srv.add_check("elastic", elastic_check(ctl))
+    code, body = srv.health()
+    assert code == 200
+    ctl.reconfiguring = True
+    code, body = srv.health()
+    assert code == 503
+    assert any("reconfiguration in flight" in r for r in body["reasons"])
+    assert "generation 3" in body["reasons"][0]
+    ctl.reconfiguring = False
+    code, _ = srv.health()
+    assert code == 200
+
+
+def test_healthz_registry_flag_fallback_without_check():
+    from dcnn_tpu.obs import TelemetryServer
+    from dcnn_tpu.obs.registry import MetricsRegistry
+    from dcnn_tpu.obs.tracer import Tracer
+
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg, tracer=Tracer())
+    assert srv.health()[0] == 200
+    reg.gauge("elastic_reconfiguring", "flag").set(1)
+    code, body = srv.health()
+    assert code == 503
+    assert any("elastic_reconfiguring" in r for r in body["reasons"])
+    reg.gauge("elastic_reconfiguring", "flag").set(0)
+    assert srv.health()[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# feed pool re-plan + trainer delegation
+# ---------------------------------------------------------------------------
+
+def test_elastic_with_feed_pool_matches_plain_path():
+    """The FeedWorkerPool-fed controller reproduces the loader-fed run
+    bit-exactly (the pool's serial path is the gather reference), proving
+    the world-size-parameterized re-plan hands the same rows."""
+    from dcnn_tpu.data.workers import FeedWorkerPool
+
+    _c, r_plain = _run_fleet(1, k=2, epochs=2)
+    socks = [comm.listen(0, host="127.0.0.1")]
+    peers = [PeerSpec(0, "127.0.0.1", socks[0].getsockname()[1])]
+    cfg = TrainingConfig(
+        epochs=2, learning_rate=0.05, seed=3, snapshot_dir=None,
+        elastic=True, elastic_microbatches=2, elastic_timeout_s=15.0,
+        elastic_heartbeat_s=0.0)
+    pool = FeedWorkerPool(X, Y, max_rows=BATCH, num_workers=0)
+    ctl = ElasticController(
+        _model(), SGD(0.05), "softmax_crossentropy", _loader(),
+        config=cfg, rank=0, peers=peers, listen_sock=socks[0],
+        feed_pool=pool)
+    ts = ctl.fit(epochs=2)
+    for a, b in zip(_leaves(r_plain[0]), _leaves(ts)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_trainer_fit_delegates_to_elastic():
+    from dcnn_tpu.train.trainer import Trainer, create_train_state
+
+    cfg = TrainingConfig(
+        epochs=2, learning_rate=0.05, seed=3, snapshot_dir=None,
+        elastic=True, elastic_rank=0, elastic_microbatches=1,
+        elastic_heartbeat_s=0.0)
+    trainer = Trainer(_model(), SGD(0.05), "softmax_crossentropy", cfg)
+    ts = create_train_state(trainer.model, trainer.optimizer,
+                            jax.random.PRNGKey(cfg.seed))
+    ts = trainer.fit(ts, _loader())
+    assert len(trainer.history) == 2
+    assert trainer.history[0]["world"] == 1
+    assert np.isfinite(trainer.history[-1]["train_loss"])
+
+
+def test_elastic_fit_wires_feed_workers(monkeypatch):
+    """TrainingConfig.feed_workers must not become a silent no-op on the
+    elastic path: elastic_fit builds the FeedWorkerPool and hands it to
+    the controller (patched to the serial backend for determinism)."""
+    import dcnn_tpu.data.workers as workers_mod
+    from dcnn_tpu.train.trainer import Trainer, create_train_state
+
+    created = {}
+    real_pool = workers_mod.FeedWorkerPool
+
+    def fake_pool(x, y, max_rows, **kw):
+        created.update(kw, max_rows=max_rows)
+        return real_pool(x, y, max_rows, num_workers=0,
+                         seed=kw.get("seed", 0))
+
+    monkeypatch.setattr(workers_mod, "FeedWorkerPool", fake_pool)
+    cfg = TrainingConfig(
+        epochs=1, learning_rate=0.05, seed=3, snapshot_dir=None,
+        elastic=True, elastic_rank=0, elastic_microbatches=1,
+        elastic_heartbeat_s=0.0, feed_workers=3)
+    trainer = Trainer(_model(), SGD(0.05), "softmax_crossentropy", cfg)
+    ts = create_train_state(trainer.model, trainer.optimizer,
+                            jax.random.PRNGKey(cfg.seed))
+    trainer.fit(ts, _loader())
+    assert created["num_workers"] == 3
+    assert created["max_rows"] == BATCH
+    # and the pooled run matches the plain solo run bit-exactly
+    _c, r_plain = _run_fleet(1, k=1, epochs=1)
+    t2 = Trainer(_model(), SGD(0.05), "softmax_crossentropy", cfg)
+    ts2 = create_train_state(t2.model, t2.optimizer,
+                             jax.random.PRNGKey(cfg.seed))
+    ts2 = t2.fit(ts2, _loader())
+    for a, b in zip(_leaves(r_plain[0]), _leaves(ts2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_elastic_validates_grid_divisibility():
+    cfg = TrainingConfig(elastic=True, elastic_microbatches=5)
+    with pytest.raises(ValueError, match="not divisible"):
+        ElasticController(
+            _model(), SGD(0.05), "softmax_crossentropy", _loader(),
+            config=cfg, rank=0, peers=[PeerSpec(0, "127.0.0.1", 0)])
+    cfg2 = TrainingConfig(elastic=True, elastic_microbatches=3)
+    with pytest.raises(ValueError, match="initial world"):
+        ElasticController(
+            _model(), SGD(0.05), "softmax_crossentropy", _loader(),
+            config=cfg2, rank=0,
+            peers=[PeerSpec(0, "127.0.0.1", 0),
+                   PeerSpec(1, "127.0.0.1", 1)])
