@@ -54,7 +54,12 @@ BENCH_MATRIX=1 for the layout/dtype sweep, BENCH_RESIDENT_SAMPLES
 jax.profiler trace, BENCH_SERVE=1 for the online-serving
 latency-vs-offered-load curve (dcnn_tpu/serve/; knobs
 BENCH_SERVE_LOADS/_SECONDS/_MAX_BATCH/_WAIT_MS/_QUEUE/_INT8 — emitted
-under a "serving" key), BENCH_OBS=1 to enable the unified tracer
+under a "serving" key) plus the router-tier block (serving.router:
+N-replica vs 1-replica capacity probe, latency-vs-load through the
+Router, and a kill-a-replica availability sub-soak; knobs
+BENCH_SERVE_ROUTER=0 to skip, BENCH_SERVE_ROUTER_REPLICAS default 4,
+BENCH_SERVE_ROUTER_SECONDS per-phase traffic window, regression-gated
+via serving.router.* keys in dcnn_tpu/obs/regress.py), BENCH_OBS=1 to enable the unified tracer
 (dcnn_tpu/obs/) for the whole run — writes a Chrome trace_event artifact
 (BENCH_OBS_TRACE, default /tmp/dcnn_bench_trace.json; open in Perfetto:
 training step spans on the "train" track, per-chunk H2D gather/put spans
@@ -694,7 +699,7 @@ def serve_section(data_format, engine=None, loads=None, seconds=None):
             "shed_fraction": rnd(s["shed_fraction"], 4),
             "completed": s["requests_completed"],
         })
-    return {
+    doc = {
         "graph": engine.name,
         "device_kind": jax.devices()[0].device_kind,
         "max_batch": engine.max_batch,
@@ -704,6 +709,194 @@ def serve_section(data_format, engine=None, loads=None, seconds=None):
         "seconds_per_point": seconds,
         "capacity_img_per_sec": round(capacity, 1),
         "loads": points,
+    }
+    # router tier (only on the env-driven bench path: the tier-1 structure
+    # test injects its own engine and exercises router_section directly)
+    if os.environ.get("BENCH_SERVE_ROUTER", "1") == "1" \
+            and "BENCH_SERVE_LOADS" not in os.environ:
+        doc["router"] = router_section(data_format)
+    return doc
+
+
+def router_section(data_format, engines=None, seconds=None,
+                   load_fracs=(0.25, 0.5, 0.8)):
+    """BENCH_SERVE=1 ``serving.router`` block: the router-tier headlines
+    (dcnn_tpu/serve/router.py; regression-gated via ``serving.router.*``
+    keys in obs/regress.py):
+
+    - **capacity probe** — closed-loop img/s of 1 replica vs N replicas
+      driven concurrently (``capacity_scaling_x`` is the router tier's
+      reason to exist; the acceptance bar is >= 3x at the default 4
+      in-process replicas on the build host);
+    - **latency-vs-load curve THROUGH the router** — open-loop batch-8
+      requests at fractions of the N-replica capacity, per-point
+      p50/p99/shed from RouterMetrics;
+    - **kill-a-replica availability sub-soak** — one replica is killed
+      mid-soak; availability = completed/accepted (accepted work is
+      re-admitted to survivors, so this should stay ~1.0), plus typed
+      failures, shed fraction, and whether the restarted replica
+      rejoined.
+
+    The probe graph is a dispatch-heavy serving CNN (28x28 two-conv
+    stack) at max_batch 64 rather than the ResNet-18 headline model:
+    per-replica scaling is a property of the router tier, and N copies
+    of the big graph would spend the whole budget compiling. Engines are
+    injectable for the tier-1 structure test."""
+    import threading as _threading
+
+    import numpy as np
+    import jax
+
+    from dcnn_tpu.serve import InferenceEngine, LocalReplica, Router, \
+        RouterMetrics, open_loop
+
+    n_replicas = int(os.environ.get("BENCH_SERVE_ROUTER_REPLICAS", "4"))
+    if seconds is None:
+        seconds = float(os.environ.get("BENCH_SERVE_ROUTER_SECONDS", "1.5"))
+    if engines is None:
+        from dcnn_tpu.nn import SequentialBuilder
+        from dcnn_tpu.optim import Adam
+        from dcnn_tpu.train.trainer import create_train_state
+
+        mb = int(os.environ.get("BENCH_SERVE_ROUTER_MAX_BATCH", "64"))
+        model = (SequentialBuilder(name="router_probe",
+                                   data_format=data_format or "NHWC")
+                 .input((28, 28, 1))
+                 .conv2d(32, 3, padding=1).batchnorm().activation("relu")
+                 .conv2d(32, 3, padding=1).batchnorm().activation("relu")
+                 .maxpool2d(2).flatten().dense(10)
+                 .build())
+        ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(21))
+        engines = [InferenceEngine.from_model(
+            model, ts.params, ts.state, max_batch=mb,
+            name=f"router-probe-{i}") for i in range(n_replicas)]
+    n_replicas = len(engines)
+    mb = engines[0].max_batch
+    rng = np.random.default_rng(23)
+    pool = rng.normal(size=(mb, *engines[0].input_shape)
+                      ).astype(np.float32)
+
+    # -- capacity probe: 1 replica vs N driven concurrently ---------------
+    def closed_loop(eng, secs):
+        n = 0
+        np.asarray(eng.run_padded(pool))  # warm/settle
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            np.asarray(eng.run_padded(pool))
+            n += mb
+        return n / (time.perf_counter() - t0)
+
+    cap1 = closed_loop(engines[0], seconds)
+    rates = [0.0] * n_replicas
+
+    def probe(i):
+        rates[i] = closed_loop(engines[i], seconds)
+
+    threads = [_threading.Thread(target=probe, args=(i,), daemon=True)
+               for i in range(n_replicas)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cap_n = sum(rates)
+
+    # -- latency-vs-load through the router -------------------------------
+    replicas = [LocalReplica(eng, name=f"bench-r{i}", queue_capacity=8 * mb,
+                             max_wait_ms=1.0)
+                for i, eng in enumerate(engines)]
+    points = []
+    kill_doc = {}
+    router = Router(replicas, metrics=RouterMetrics())
+    try:
+        # rows per request: offered img/s = rps * batch (8 at the default
+        # max_batch 64; smaller when an injected engine's buckets are)
+        batch = min(8, max(1, mb // 4))
+        samples = [pool[j:j + batch] for j in range(0, mb - batch, batch)]
+        for frac in load_fracs:
+            rps = max(frac * cap_n / batch, 1.0)
+            m = RouterMetrics()
+            router.metrics = m
+            open_loop(router, samples, rps, seconds)
+            deadline = time.monotonic() + 60
+            while router.outstanding() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            s = m.snapshot()["total"]
+            lat = m.snapshot()["normal"]
+            rnd = lambda v, k=2: None if v is None else round(v, k)
+            points.append({
+                "offered_img_per_sec": round(rps * batch, 1),
+                "achieved_rps": rnd(s["throughput_rps"], 1),
+                "p50_ms": rnd(lat["p50_ms"]),
+                "p99_ms": rnd(lat["p99_ms"]),
+                "shed_fraction": rnd(s["shed_fraction"], 4),
+                "completed": s["completed"],
+                "failed": s["failed"],
+            })
+
+        # -- kill-a-replica availability sub-soak -------------------------
+        m = RouterMetrics()
+        router.metrics = m
+        victim = replicas[0]
+        # the kill must fire mid-soak even when the generator never
+        # sleeps (an overloaded open loop is behind schedule constantly),
+        # so it rides a timer, not the pacing hook
+        killer = _threading.Timer(seconds / 2, victim.kill)
+        killer.daemon = True
+        killer.start()
+
+        def soak_sleep(dt):
+            time.sleep(dt)
+            router.check_replicas()
+
+        rps = max(0.4 * cap_n / batch, 1.0)
+        futs = open_loop(router, samples, rps, seconds, sleep=soak_sleep)
+        killer.join()  # the kill has fired by end-of-soak + join
+        if not victim.is_dead():
+            victim.kill()
+        router.check_replicas()
+        deadline = time.monotonic() + 60
+        while router.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        accepted = len(futs)
+        completed = sum(1 for _, f in futs
+                        if f.done() and f.exception() is None)
+        typed_failures = sum(1 for _, f in futs
+                             if f.done() and f.exception() is not None)
+        undone = accepted - completed - typed_failures
+        victim.restart()
+        rejoined = router.check_replicas().get("bench-r0") == "rejoined"
+        s = m.snapshot()["total"]
+        kill_doc = {
+            "offered_img_per_sec": round(rps * batch, 1),
+            "accepted": accepted,
+            "completed": completed,
+            "typed_failures": typed_failures,
+            "silently_dropped": undone,  # MUST be 0 — the ledger contract
+            "availability": round(completed / accepted, 4) if accepted
+            else None,
+            "shed_fraction": round(s["shed_fraction"], 4),
+            "replica_deaths": int(m.registry.snapshot()[
+                "serve_router_replica_deaths_total"]),
+            "rejoined_after_restart": rejoined,
+        }
+    finally:
+        router.shutdown(drain=False)
+        for r in replicas:
+            try:
+                r.close()
+            except Exception:
+                pass
+
+    return {
+        "replicas": n_replicas,
+        "max_batch": mb,
+        "graph": engines[0].name,
+        "seconds_per_phase": seconds,
+        "capacity_1_img_per_sec": round(cap1, 1),
+        "capacity_img_per_sec": round(cap_n, 1),
+        "capacity_scaling_x": round(cap_n / cap1, 2) if cap1 else None,
+        "loads": points,
+        "kill_soak": kill_doc,
     }
 
 

@@ -60,7 +60,10 @@ class MetricSpec:
 
 
 # The ISSUE-mandated gate set: img/s, MFU, h2d bandwidth, compile wall,
-# int8 serving. Tolerances per the noise notes in the module docstring.
+# int8 serving, and the router-tier headlines (BENCH_SERVE=1 `serving.
+# router` block). Tolerances per the noise notes in the module docstring;
+# `availability` during the kill-a-replica soak is a correctness-adjacent
+# number, so its tolerance is tight.
 DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("img_per_sec", "value"),
     MetricSpec("mfu", "mfu"),
@@ -68,6 +71,14 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("compile_s", "phases.compile_s", higher_is_better=False,
                tolerance=0.5, guard="phases.compile_cache_hit"),
     MetricSpec("serve_int8_img_per_sec", "infer_int8_img_per_sec"),
+    MetricSpec("serve_router_capacity_img_per_sec",
+               "serving.router.capacity_img_per_sec",
+               guard="serving.router.replicas"),
+    MetricSpec("serve_router_capacity_scaling",
+               "serving.router.capacity_scaling_x",
+               guard="serving.router.replicas"),
+    MetricSpec("serve_router_kill_availability",
+               "serving.router.kill_soak.availability", tolerance=0.05),
 )
 
 DEFAULT_TOLERANCE = 0.2

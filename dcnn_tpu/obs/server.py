@@ -64,6 +64,12 @@ _HEALTH_FLAGS = (
     "ckpt_last_step", "ckpt_saves_total", "ckpt_restore_skipped_total",
     "elastic_generation", "elastic_world_size", "elastic_reconfiguring",
     "elastic_reconfigures_total", "elastic_peers_lost_total",
+    # router tier (serve/router.py): fleet shape + the counters a prober
+    # wants next to the 200/503 verdict
+    "serve_router_replicas", "serve_router_replicas_routable",
+    "serve_router_canary_replicas", "serve_router_version",
+    "serve_router_replica_deaths_total", "serve_router_rejoins_total",
+    "serve_router_rollbacks_total", "serve_router_promotions_total",
 )
 
 

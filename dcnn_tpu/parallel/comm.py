@@ -151,6 +151,25 @@ class Channel:
                 else _CODEC.decompress_array(blob)
         return meta.pop("cmd"), meta, payload
 
+    def set_send_timeout(self, seconds: float) -> None:
+        """Kernel-level send deadline (``SO_SNDTIMEO``). Unlike a
+        Python-level socket timeout it does NOT affect a reader thread's
+        blocking ``recv`` — which is exactly what the liveness designs
+        built on this channel need: a silently partitioned peer whose
+        receive window fills must fail our *send* within the budget
+        (the raised ``OSError`` rides the caller's mark-dead path)
+        instead of wedging on TCP-retransmit timescales, while an idle
+        recv may legitimately block for minutes (jit compile, epoch
+        gap). Used by the elastic membership mesh and the serve router's
+        TCP replica client. No-op on platforms without the option."""
+        t = max(float(seconds), 1.0)
+        try:
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", int(t), int((t % 1.0) * 1e6)))
+        except (OSError, ValueError):
+            pass  # platform without SO_SNDTIMEO: close/timeout paths remain
+
     def close(self) -> None:
         try:
             self._sock.shutdown(socket.SHUT_RDWR)

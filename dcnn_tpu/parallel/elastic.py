@@ -224,20 +224,11 @@ class Membership:
             self._start_beat_thread()
 
     def _register(self, rank: int, ch: Channel) -> None:
-        # kernel-level send deadline (SO_SNDTIMEO — unlike a Python-level
-        # socket timeout it does NOT affect the reader thread's recv):
-        # a silently partitioned peer whose receive window fills must fail
-        # the send within peer_timeout_s, not block the whole generation
-        # for TCP-retransmit timescales. The raised OSError rides the
-        # normal mark-dead path.
-        import struct as _struct
-        t = max(self.peer_timeout_s, 1.0)
-        try:
-            ch._sock.setsockopt(
-                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                _struct.pack("ll", int(t), int((t % 1.0) * 1e6)))
-        except OSError:
-            pass  # platform without SO_SNDTIMEO: close/timeout paths remain
+        # kernel-level send deadline: a silently partitioned peer whose
+        # receive window fills must fail the send within peer_timeout_s,
+        # not block the whole generation for TCP-retransmit timescales.
+        # The raised OSError rides the normal mark-dead path.
+        ch.set_send_timeout(self.peer_timeout_s)
         with self._lock:
             self._channels[rank] = ch
             self._last_heard[rank] = self._clock()

@@ -75,9 +75,11 @@ class RestoredCheckpoint(NamedTuple):
     path: str
 
 
-def _verify_dir(path: str) -> bool:
+def verify_dir(path: str) -> bool:
     """True iff ``path`` holds a complete checkpoint whose files match its
-    manifest's SHA-256 sums. Cheap checks (existence, size) run first."""
+    manifest's SHA-256 sums. Cheap checks (existence, size) run first.
+    Public: the serving tier (``serve/swap.py``) uses it to pick the
+    newest *valid* version without loading anything."""
     mpath = os.path.join(path, _MANIFEST)
     try:
         with open(mpath, "r", encoding="utf-8") as f:
@@ -99,7 +101,7 @@ def _verify_dir(path: str) -> bool:
 
 def list_steps(directory: str) -> Dict[int, str]:
     """Committed checkpoint steps under ``directory`` → absolute path.
-    Presence only; validity is :func:`_verify_dir`'s job."""
+    Presence only; validity is :func:`verify_dir`'s job."""
     out: Dict[int, str] = {}
     if not os.path.isdir(directory):
         return out
@@ -127,7 +129,7 @@ def restore_latest(directory: str, seed: int = 0,
     steps = sorted(list_steps(directory).items(), reverse=True)
     for step, path in steps:
         with tracer.span("checkpoint.restore", track="ckpt", step=step):
-            if not _verify_dir(path):
+            if not verify_dir(path):
                 # quarantine, don't just skip: a resumed run will want to
                 # commit this step number again, and an immutable corrupt
                 # dir squatting on it would turn recovery into

@@ -53,6 +53,20 @@ Trip points wired in this PR (grep for ``faults.trip`` to enumerate):
                                 ``exc=InjectedCrash`` on a *second* peer it
                                 proves a loss during recovery is survived
                                 (reconfigure idempotence)
+``serve.route``                 fail the router's admission/dispatch path for
+                                request ``at=i`` (``serve/router.py``) — the
+                                routing-layer-itself chaos hook
+``serve.replica_infer``         fire in a replica's dispatch: ``InjectedFault``
+                                is one failed request (the canary-degradation
+                                fixture — the router re-admits it elsewhere
+                                and counts it against the replica/version);
+                                ``InjectedCrash`` kills the replica (in-flight
+                                requests die, the router ejects + re-admits;
+                                ``serve/replica.py``)
+``serve.swap``                  fail a version swap's engine-load step
+                                (``serve/swap.py``) — the replica rejoins on
+                                its OLD version; ``InjectedCrash`` = died
+                                mid-swap
 ==============================  ==============================================
 
 This module is stdlib-only and import-safe from any layer.

@@ -27,19 +27,48 @@ The whole path is traced on the unified tracer (``dcnn_tpu.obs``):
 instants — a request's latency decomposes into queue/batch/compute on a
 Perfetto timeline (docs/observability.md).
 
+On top of the single-replica stack sits the **router tier**
+(docs/deployment.md §"Router tier"):
+
+- :class:`~dcnn_tpu.serve.router.Router` — fronts N replicas
+  (:class:`~dcnn_tpu.serve.replica.LocalReplica` in-process,
+  :class:`~dcnn_tpu.serve.replica.TcpReplica` over ``parallel/comm.py``
+  framing) with priority-class admission (low sheds first),
+  least-loaded health-driven routing, replica-death ejection +
+  re-admission of accepted work, and rejoin;
+- :class:`~dcnn_tpu.serve.swap.ModelVersionManager` — watches
+  ``CheckpointManager`` commits and rolls new versions out canary-first
+  with auto-promote / instant rollback
+  (:class:`~dcnn_tpu.serve.swap.EngineFactory` builds the per-version
+  engines).
+
 End-to-end drivers: ``examples/serve_snapshot.py`` (committed digits28
-snapshot under open-loop traffic) and ``BENCH_SERVE=1 python bench.py``
-(latency-vs-offered-load curve). Quickstart: docs/deployment.md §5.
+snapshot under open-loop traffic), ``examples/serve_router.py`` (the
+router tier: replica kill + rejoin + hot-swap), and ``BENCH_SERVE=1
+python bench.py`` (latency-vs-offered-load curve + ``router`` block).
+Quickstart: docs/deployment.md §5.
 """
 
 from .engine import InferenceEngine, serve_buckets
-from .batcher import DynamicBatcher, QueueFullError, ShutdownError
-from .metrics import ServeMetrics
+from .batcher import (
+    DrainingError, DynamicBatcher, QueueFullError, ShutdownError,
+)
+from .metrics import PRIORITIES, RouterMetrics, ServeMetrics
+from .replica import (
+    LocalReplica, ReplicaDeadError, ReplicaError, ReplicaServer, SwapError,
+    TcpReplica,
+)
+from .router import NoReplicasError, Router, RouterShedError
+from .swap import EngineFactory, ModelVersionManager, newest_valid_version
 from .traffic import open_loop
 
 __all__ = [
     "InferenceEngine", "serve_buckets",
-    "DynamicBatcher", "QueueFullError", "ShutdownError",
-    "ServeMetrics",
+    "DynamicBatcher", "DrainingError", "QueueFullError", "ShutdownError",
+    "ServeMetrics", "RouterMetrics", "PRIORITIES",
+    "LocalReplica", "TcpReplica", "ReplicaServer",
+    "ReplicaError", "ReplicaDeadError", "SwapError",
+    "Router", "RouterShedError", "NoReplicasError",
+    "EngineFactory", "ModelVersionManager", "newest_valid_version",
     "open_loop",
 ]

@@ -51,6 +51,15 @@ class QueueFullError(RuntimeError):
     """Backpressure: the bounded request queue is at capacity."""
 
 
+class DrainingError(RuntimeError):
+    """Intake refused because the batcher is draining or shut down — a
+    *typed* rejection, so a router can tell "replica temporarily not
+    accepting (swap/drain in progress; fail over and maybe come back)"
+    apart from a programming error. Subclasses ``RuntimeError`` so the
+    pre-router contract (``submit`` raises ``RuntimeError`` after
+    ``drain``/``shutdown``) is unchanged."""
+
+
 class ShutdownError(RuntimeError):
     """The batcher shut down (or a timed drain gave up) before this
     request could be served. Raised from the request's future — never
@@ -138,7 +147,7 @@ class DynamicBatcher:
         tracer = get_tracer()
         with self._cond:
             if self._closing:
-                raise RuntimeError("batcher is draining or shut down")
+                raise DrainingError("batcher is draining or shut down")
             if self._rows + n > self.queue_capacity:
                 self.metrics.record_shed(n)
                 tracer.instant("serve.shed", track="serve.queue", n=n)
@@ -219,6 +228,7 @@ class DynamicBatcher:
         srv.add_snapshot("serve", self.metrics.snapshot)
         srv.add_snapshot("engine", lambda: {
             "name": self.engine.name,
+            "version": getattr(self.engine, "version", None),
             "buckets": self.engine.bucket_sizes,
             "batch_invariant": self.engine.batch_invariant,
             "compile_stats": self.engine.compile_stats,

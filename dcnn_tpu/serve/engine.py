@@ -80,8 +80,12 @@ class InferenceEngine:
                  max_batch: int = 32, input_dtype: Any = jnp.float32,
                  donate: Optional[bool] = None, warmup: bool = True,
                  batch_invariant: bool = False, name: str = "engine",
-                 registry=None):
+                 version: Optional[Any] = None, registry=None):
         self.name = name
+        # model-version identity (the CheckpointManager step for engines
+        # built by serve/swap.py's EngineFactory; None for ad-hoc engines).
+        # The router tier and ModelVersionManager route/report on it.
+        self.version = version
         # cost/HBM gauges land here (default: the process-global registry);
         # a batcher's start_telemetry additionally mirrors them onto its
         # own scrape registry so a private-registry replica still exposes
@@ -279,6 +283,7 @@ class InferenceEngine:
         return y[0] if single else y
 
     def __repr__(self) -> str:
+        ver = f", version={self.version!r}" if self.version is not None else ""
         return (f"InferenceEngine({self.name!r}, input={self.input_shape}, "
                 f"buckets={self.bucket_sizes}, "
-                f"batch_invariant={self.batch_invariant})")
+                f"batch_invariant={self.batch_invariant}{ver})")

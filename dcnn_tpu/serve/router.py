@@ -1,0 +1,727 @@
+"""Fault-tolerant multi-replica serving router.
+
+The missing tier between "one engine + one batcher on one device"
+(PR 2) and the ROADMAP's million-user traffic goal: a :class:`Router`
+fronts N replicas (:mod:`~dcnn_tpu.serve.replica` — in-process or behind
+TCP hosts) and owns three guarantees the single-replica stack cannot
+give:
+
+1. **SLO-aware admission.** Requests carry a priority class
+   (``high`` / ``normal`` / ``low``). Admission is layered on the
+   ``DynamicBatcher`` shed path: the router admits a class only while
+   total outstanding rows stay under that class's share of the fleet's
+   aggregate queue capacity (``high`` = 1.0 by default), so under load
+   the low class saturates — and sheds — first, and the shed error is
+   the same *typed backpressure* (:class:`RouterShedError`, a
+   ``QueueFullError``) callers already handle. A request that clears
+   admission but finds every individual replica full is shed too
+   (admission is aggregate; per-replica capacity is the ground truth).
+2. **No silent drops.** Every admitted request enters an accepted-ledger
+   and leaves it in exactly one of two ways: its future resolves with
+   the result, or with a *typed* error. A replica that dies with
+   accepted-but-unanswered requests (connection close, injected crash,
+   last-heard timeout — never detected by hanging) is ejected and those
+   requests are **re-admitted to survivors** through the shared
+   ``resilience.retry`` backoff primitive, bounded by ``max_readmits``;
+   exhaustion resolves the future with the last typed error. A restarted
+   replica rejoins on the next :meth:`Router.check_replicas` sweep.
+3. **Health/latency-driven routing.** Dispatch picks the routable
+   replica with the fewest router-tracked outstanding rows (ties: lowest
+   completion-latency EWMA) — the per-replica ``/healthz`` + ``/metrics``
+   contract from PR 6 stays the external scrape surface, while in-band
+   the router reads the same verdicts via ``replica.health()``/pongs.
+
+Versioned hot-swap / canary / rollback live in
+:class:`~dcnn_tpu.serve.swap.ModelVersionManager`, which drives
+:meth:`Router.swap_replica` (drain → load → rejoin per replica).
+
+Observability: every decision lands on
+:class:`~dcnn_tpu.serve.metrics.RouterMetrics` (``serve_router_*``
+series), and :meth:`Router.start_telemetry` exposes the router's own
+``/metrics`` / ``/healthz`` / ``/snapshot`` — ``/healthz`` goes 503 when
+no replica is routable, when the router is draining, or when a sweep
+finds the fleet degraded below ``min_routable``.
+
+Chaos surface: ``serve.route`` trips in :meth:`Router.submit` (armed =
+routing-layer failure), ``serve.replica_infer`` in every replica
+dispatch, ``serve.swap`` in the version-load path
+(docs/reliability.md fault cookbook).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from concurrent.futures import CancelledError, Future, InvalidStateError
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..resilience import faults as _faults
+from ..resilience.retry import retry_call
+from .batcher import DrainingError, QueueFullError
+from .metrics import PRIORITIES, RouterMetrics
+from .replica import DEATH_ERRORS, ReplicaDeadError, ReplicaError
+
+#: Default admission shares: the fraction of aggregate fleet queue
+#: capacity each priority class may fill. ``high`` may use everything;
+#: ``low`` sheds once the fleet is 60% committed — the SLO knob.
+DEFAULT_SHARES: Dict[str, float] = {"high": 1.0, "normal": 0.85,
+                                    "low": 0.6}
+
+
+class RouterShedError(QueueFullError):
+    """Admission rejected this request (its priority class is over its
+    share, or the fleet is out of capacity). Subclasses
+    ``QueueFullError`` so every existing backpressure handler — the
+    open-loop generator included — treats router shed as batcher shed."""
+
+
+class NoReplicasError(ReplicaError):
+    """No routable replica exists (all dead/draining). Typed terminal
+    failure for accepted requests that exhausted re-admission."""
+
+
+class _Handle:
+    """Router-side state for one replica. Every field except ``name`` and
+    ``replica`` is mutated under the router's ``_lock``."""
+
+    __slots__ = ("name", "replica", "state", "outstanding", "completed",
+                 "failed", "consecutive_failures", "ewma_ms", "canary",
+                 "last_seq", "auto_rejoin")
+
+    def __init__(self, name: str, replica):
+        self.name = name
+        self.replica = replica
+        self.state = "up"            # up | unroutable | dead
+        self.outstanding = 0         # rows dispatched, not yet settled
+        self.completed = 0
+        self.failed = 0
+        self.consecutive_failures = 0
+        self.ewma_ms: Optional[float] = None
+        self.canary = False
+        self.last_seq = 0            # routing round-robin stamp
+        # False when ejected for failing REQUESTS while health passed
+        # (failure_eject_threshold): the sweep must not flap it back in
+        # on the same health probe that was lying — rejoin is explicit
+        self.auto_rejoin = True
+
+
+class _Request:
+    __slots__ = ("x", "n", "priority", "future", "t_submit", "attempts",
+                 "tried")
+
+    def __init__(self, x, n, priority, t_submit):
+        self.x, self.n, self.priority = x, n, priority
+        self.future: Future = Future()
+        self.t_submit = t_submit
+        self.attempts = 0            # re-admissions consumed
+        self.tried: set = set()      # replica names tried THIS admission
+
+
+class Router:
+    """N-replica serving front-end: priority admission, least-loaded
+    routing, replica-death re-admission, rejoin, hot-swap hooks.
+
+    ``clock``/``sleep`` are injectable (the re-admission backoff and all
+    latency accounting run sleep-free in tests). ``replicas`` may be an
+    iterable of replica objects (named by their ``.name``) or
+    ``(name, replica)`` pairs.
+    """
+
+    def __init__(self, replicas=(), *, shares: Optional[Dict[str, float]]
+                 = None, max_readmits: int = 3, min_routable: int = 1,
+                 failure_eject_threshold: int = 0,
+                 metrics: Optional[RouterMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 name: str = "router"):
+        self.name = name
+        self.shares = dict(DEFAULT_SHARES if shares is None else shares)
+        unknown = set(self.shares) - set(PRIORITIES)
+        if unknown:
+            raise ValueError(f"unknown priority classes {sorted(unknown)}; "
+                             f"known: {PRIORITIES}")
+        for p in PRIORITIES:
+            self.shares.setdefault(p, 1.0)
+        self.max_readmits = max_readmits
+        self.min_routable = min_routable
+        # >0: eject a replica after this many CONSECUTIVE failed requests
+        # even while its health probe still passes (a replica that answers
+        # pings but fails every request is dead for routing purposes)
+        self.failure_eject_threshold = failure_eject_threshold
+        self.metrics = metrics if metrics is not None else RouterMetrics(
+            clock=clock)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._handles: Dict[str, _Handle] = {}  # dcnn: guarded_by=_lock
+        self._ledger: set = set()               # dcnn: guarded_by=_lock
+        self._outstanding = 0                   # dcnn: guarded_by=_lock
+        self._closing = False                   # dcnn: guarded_by=_lock
+        self._seq = 0                           # dcnn: guarded_by=_lock
+        self._telemetry = None
+        for item in replicas:
+            if isinstance(item, tuple):
+                self.add_replica(item[1], name=item[0])
+            else:
+                self.add_replica(item)
+
+    # -- fleet management --------------------------------------------------
+    def add_replica(self, replica, name: Optional[str] = None) -> str:
+        with self._lock:
+            if name is None:
+                name = getattr(replica, "name", None) \
+                    or f"replica-{len(self._handles)}"
+            if name in self._handles:
+                raise ValueError(f"replica {name!r} already registered")
+            self._handles[name] = _Handle(name, replica)
+            self._update_gauges_locked()
+        return name
+
+    def remove_replica(self, name: str) -> None:
+        """Administratively drop a replica (it is NOT closed — the caller
+        owns its lifecycle). In-flight requests settle normally."""
+        with self._lock:
+            self._handles.pop(name, None)
+            self._update_gauges_locked()
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._handles)
+
+    def _update_gauges_locked(self) -> None:
+        m = self.metrics
+        m.replicas.set(len(self._handles))
+        routable = [h for h in self._handles.values() if h.state == "up"]
+        m.replicas_routable.set(len(routable))
+        m.capacity_rows.set(sum(h.replica.queue_capacity for h in routable))
+        m.outstanding_rows.set(self._outstanding)
+        m.canary_replicas.set(
+            sum(1 for h in self._handles.values() if h.canary))
+
+    # -- admission + dispatch ----------------------------------------------
+    def submit(self, x, priority: str = "normal") -> Future:
+        """Admit one request (single sample or small batch, batcher
+        conventions) into its priority class. Returns a future resolving
+        to the logits, or to a typed error — never silently dropped.
+        Raises :class:`RouterShedError` at admission (not accepted) and
+        ``DrainingError`` after :meth:`drain`/:meth:`shutdown`."""
+        if priority not in self.shares:
+            raise ValueError(f"unknown priority {priority!r}; "
+                             f"known: {PRIORITIES}")
+        _faults.trip("serve.route", priority=priority)
+        x = np.asarray(x)
+        with self._lock:
+            if self._closing:
+                raise DrainingError("router is draining or shut down")
+            shp = self._input_shape_locked()
+            n = 1 if (shp is not None and tuple(x.shape) == shp) \
+                else (int(x.shape[0]) if x.ndim > 0 else 1)
+            cap = sum(h.replica.queue_capacity
+                      for h in self._handles.values() if h.state == "up")
+            limit = self.shares[priority] * cap
+            if cap == 0 or self._outstanding + n > limit:
+                self.metrics.record_shed(priority, n)
+                raise RouterShedError(
+                    f"{priority}-priority request of {n} shed: outstanding "
+                    f"{self._outstanding} + {n} over class limit "
+                    f"{limit:g} (fleet capacity {cap})")
+            req = _Request(x, n, priority, self._clock())
+            self._ledger.add(req)
+            self._outstanding += n
+            self.metrics.outstanding_rows.set(self._outstanding)
+        try:
+            self._first_dispatch(req)
+        except RouterShedError:
+            # aggregate admission passed but every replica's own queue
+            # shed: undo acceptance — the caller sees one coherent shed,
+            # counted ONLY as shed (it was never truly admitted)
+            if self._retire(req):
+                self.metrics.record_shed(priority, req.n)
+            raise
+        except BaseException:
+            # anything non-typed out of the dispatch path (a malformed
+            # request the replica's own validation rejects, an injected
+            # routing fault) is the CALLER's error: un-admit so the
+            # ledger cannot leak the request, then propagate
+            self._retire(req)
+            raise
+        # counted as admitted only once placement is secured (or the
+        # future already failed typed — still an accepted request), so a
+        # shed request never double-counts in offered traffic
+        self.metrics.record_submit(priority, n)
+        return req.future
+
+    def _input_shape_locked(self):
+        for h in self._handles.values():
+            shp = getattr(h.replica, "input_shape", None)
+            if shp is not None:
+                return tuple(shp)
+        return None
+
+    def _pick(self, req: _Request) -> Optional[_Handle]:
+        """Least-outstanding routable replica not yet tried for this
+        admission. Ties break on the completion-latency EWMA quantized to
+        ~30% log buckets (meaningfully slower replicas get less traffic;
+        noise-level differences do not starve anyone), then on
+        least-recently-dispatched — so an idle fleet round-robins instead
+        of pinning everything to whichever replica happens to sort
+        first."""
+        with self._lock:
+            candidates = [h for h in self._handles.values()
+                          if h.state == "up" and h.name not in req.tried]
+            if not candidates:
+                return None
+
+            def score(h: _Handle):
+                lat = (int(math.log(h.ewma_ms) * 4.0)
+                       if h.ewma_ms is not None and h.ewma_ms > 0 else 0)
+                return (h.outstanding, lat, h.last_seq)
+
+            best = min(candidates, key=score)
+            self._seq += 1
+            best.last_seq = self._seq
+            return best
+
+    def _try_replica(self, req: _Request) -> None:
+        """One dispatch attempt: pick, submit, register the settle
+        callback. Raises the replica's typed rejection for the retry
+        wrapper to classify."""
+        h = self._pick(req)
+        if h is None:
+            with self._lock:
+                fleet = {n: hh.state for n, hh in self._handles.items()}
+            raise NoReplicasError(
+                f"no routable replica for {req.priority}-priority request "
+                f"(fleet: {fleet})")
+        try:
+            inner = h.replica.submit(req.x)
+        except DEATH_ERRORS as e:
+            req.tried.add(h.name)
+            self._note_dead(h, f"submit failed: {e}")
+            raise ReplicaDeadError(str(e)) from e
+        except (QueueFullError, DrainingError, ReplicaError):
+            req.tried.add(h.name)
+            raise
+        with self._lock:
+            h.outstanding += req.n
+        inner.add_done_callback(lambda f, h=h: self._settle(req, h, f))
+
+    def _first_dispatch(self, req: _Request) -> None:
+        """Initial placement: walk the routable replicas once, least
+        loaded first. Every replica shedding ⇒ RouterShedError (the
+        caller un-admits); no replica at all ⇒ the future resolves with
+        NoReplicasError (the request WAS admitted against capacity that
+        vanished between admission and dispatch)."""
+        last: Optional[BaseException] = None
+        with self._lock:
+            rounds = max(len(self._handles), 1)
+        for _ in range(rounds):
+            try:
+                self._try_replica(req)
+                return
+            except (QueueFullError, DrainingError, ReplicaDeadError) as e:
+                last = e
+            except NoReplicasError as e:
+                # candidates ran out mid-walk (dead handles shrink the
+                # pool below `rounds`): if every replica actually TRIED
+                # shed, this is still one coherent shed, not a typed
+                # admitted-then-failed — availability metrics must not
+                # blame overload on replica deaths
+                if isinstance(last, (QueueFullError, DrainingError)):
+                    break
+                self._resolve_exc(req, e)
+                return
+        if isinstance(last, (QueueFullError, DrainingError)):
+            raise RouterShedError(f"every routable replica shed: {last}")
+        self._resolve_exc(req, NoReplicasError(
+            f"no replica accepted the request: {last}"))
+
+    def _readmit(self, req: _Request, failed: str) -> None:
+        """Re-admission after a replica-attributed failure: the accepted
+        request MUST complete or fail typed. The replica that just failed
+        it is excluded whenever another routable one exists (a request
+        must not ping-pong into the same degraded replica); the attempt
+        loop rides the shared resilience.retry backoff (visible as
+        ``serve_router_readmit_retry_attempts_total``)."""
+        with self._lock:
+            attempts = min(max(2, len(self._handles) + 1), 5)
+        self.metrics.record_readmit()
+
+        def attempt() -> None:
+            # fresh exclusion set each backoff attempt: a replica that
+            # shed on the PREVIOUS attempt gets reconsidered after the
+            # sleep (queues drain in milliseconds) — only the replica
+            # that just failed this request stays excluded, and only
+            # while another routable one exists
+            with self._lock:
+                others = any(h.state == "up" and h.name != failed
+                             for h in self._handles.values())
+            req.tried = {failed} if others else set()
+            self._try_replica(req)
+
+        try:
+            # NOTE: this runs on whatever thread settled the failed future
+            # — usually the dying replica's dispatcher — so the backoff
+            # budget is deliberately tiny (<= 4 sleeps capped at 20 ms,
+            # ~80 ms worst case per request): a survivors-briefly-full
+            # fleet gets a fair second chance without parking a
+            # dispatcher thread for whole backoff windows. Exhaustion is
+            # a typed failure, counted, never a silent drop.
+            retry_call(attempt,
+                       attempts=attempts,
+                       base=0.002, cap=0.02, timeout=0.25,
+                       retry_on=(QueueFullError, DrainingError,
+                                 ReplicaError),
+                       retry_if=lambda e: not isinstance(
+                           e, NoReplicasError),
+                       sleep=self._sleep, clock=self._clock,
+                       registry=self.metrics.registry,
+                       name="serve_router_readmit")
+        except NoReplicasError as e:
+            self._resolve_exc(req, e)
+        except (QueueFullError, DrainingError, ReplicaError) as e:
+            self._resolve_exc(req, ReplicaDeadError(
+                f"re-admission exhausted after replica death: {e}"))
+        except BaseException as e:
+            # the request is already accepted: whatever went wrong, its
+            # future must resolve typed (never a silent ledger leak)
+            self._resolve_exc(req, ReplicaError(
+                f"re-admission failed: {type(e).__name__}: {e}"))
+
+    # -- settlement --------------------------------------------------------
+    def _settle(self, req: _Request, h: _Handle, inner: Future) -> None:
+        exc: Optional[BaseException]
+        if inner.cancelled():
+            exc = CancelledError("replica-level future cancelled")
+        else:
+            exc = inner.exception()
+        with self._lock:
+            h.outstanding = max(h.outstanding - req.n, 0)
+        if exc is None:
+            t_done = self._clock()
+            lat_ms = (t_done - req.t_submit) * 1e3
+            with self._lock:
+                h.completed += 1
+                h.consecutive_failures = 0
+                h.ewma_ms = (lat_ms if h.ewma_ms is None
+                             else 0.8 * h.ewma_ms + 0.2 * lat_ms)
+            self._resolve_ok(req, inner.result(),
+                             latency_s=t_done - req.t_submit)
+            return
+        # replica-attributed failure: count it, maybe eject, re-admit
+        self.metrics.record_replica_error()
+        dead = isinstance(exc, DEATH_ERRORS)
+        with self._lock:
+            h.failed += 1
+            h.consecutive_failures += 1
+            over = (self.failure_eject_threshold > 0
+                    and h.consecutive_failures
+                    >= self.failure_eject_threshold)
+            closing = self._closing
+        if dead:
+            self._note_dead(h, f"request failed: {type(exc).__name__}: "
+                               f"{exc}")
+        elif over:
+            with self._lock:
+                h.auto_rejoin = False  # its health probe still passes —
+                # only an explicit rejoin() may re-admit it
+            self._note_dead(h, f"{h.consecutive_failures} consecutive "
+                               f"request failures")
+        if req.future.done():
+            # resolved while in flight — a drain timeout (already
+            # retired) or a caller cancel (not): retire here so a
+            # cancelled-then-failed request cannot leak the ledger
+            self._retire(req)
+            return
+        if closing or req.attempts >= self.max_readmits:
+            self._resolve_exc(req, exc if isinstance(exc, ReplicaError)
+                              else ReplicaDeadError(
+                                  f"replica {h.name} failed the request "
+                                  f"({type(exc).__name__}: {exc}) and "
+                                  f"re-admission is exhausted"))
+            return
+        req.attempts += 1
+        self._readmit(req, failed=h.name)
+
+    def _retire(self, req: _Request) -> bool:
+        """Remove ``req`` from the ledger exactly once. False when someone
+        (a drain timeout racing a late settle) already did — the loser
+        must not decrement outstanding a second time."""
+        with self._lock:
+            if req not in self._ledger:
+                return False
+            self._ledger.discard(req)
+            self._outstanding -= req.n
+            self.metrics.outstanding_rows.set(self._outstanding)
+            return True
+
+    def _resolve_ok(self, req: _Request, result,
+                    latency_s: float) -> None:
+        if not self._retire(req):
+            return
+        try:
+            req.future.set_result(result)
+            self.metrics.record_done(req.priority, latency_s, req.n)
+        except InvalidStateError:
+            pass  # cancelled by the caller while in flight
+
+    def _resolve_exc(self, req: _Request, exc: BaseException) -> None:
+        if not self._retire(req):
+            return
+        try:
+            req.future.set_exception(exc)
+            self.metrics.record_failed(req.priority, req.n)
+        except InvalidStateError:
+            pass
+
+    # -- liveness ----------------------------------------------------------
+    def _note_dead(self, h: _Handle, reason: str) -> None:
+        with self._lock:
+            if h.state == "dead":
+                return
+            h.state = "dead"
+            self._update_gauges_locked()
+        self.metrics.record_replica_death()
+
+    def check_replicas(self) -> Dict[str, Any]:
+        """One liveness sweep — the router's heartbeat, called by the
+        telemetry health check, the version manager's poll, or a test by
+        hand (sleep-free):
+
+        - ping every replica (refreshes TCP last-heard windows);
+        - a replica whose ``health()``/``is_dead()`` says dead is ejected
+          (``kill()`` sweeps its queue so accepted requests fail typed
+          and re-admit NOW, not at some timeout);
+        - an ejected replica that reports alive again (restarted process,
+          re-established channel) **rejoins**;
+        - returns the per-replica verdict map."""
+        with self._lock:
+            handles = list(self._handles.values())
+        report: Dict[str, Any] = {}
+        for h in handles:
+            r = h.replica
+            try:
+                r.ping()
+            except Exception:
+                pass  # ping failures surface via health() below
+            try:
+                reason = r.health()
+                hard_dead = r.is_dead()
+            except Exception as e:
+                reason, hard_dead = f"health probe failed: {e}", True
+            with self._lock:
+                state, auto = h.state, h.auto_rejoin
+            if state == "dead":
+                if not hard_dead and reason is None and auto:
+                    with self._lock:
+                        h.state = "up"
+                        h.consecutive_failures = 0
+                        self._update_gauges_locked()
+                    self.metrics.record_rejoin()
+                    report[h.name] = "rejoined"
+                elif not auto:
+                    report[h.name] = "dead (ejected for request " \
+                                     "failures; explicit rejoin() required)"
+                else:
+                    report[h.name] = f"dead ({reason})"
+                continue
+            if hard_dead:
+                self._note_dead(h, reason or "reported dead")
+                try:
+                    r.kill()  # sweep its queue: typed failures re-admit
+                except Exception:
+                    pass
+                report[h.name] = f"ejected ({reason})"
+            elif reason is not None:
+                with self._lock:
+                    h.state = "unroutable"
+                    self._update_gauges_locked()
+                report[h.name] = f"unroutable ({reason})"
+            else:
+                with self._lock:
+                    if h.state == "unroutable":
+                        h.state = "up"
+                        self._update_gauges_locked()
+                report[h.name] = "up"
+        return report
+
+    def rejoin(self, name: str) -> None:
+        """Explicitly re-admit a replica ejected by
+        ``failure_eject_threshold`` (the sweep never auto-rejoins those —
+        its health probe was passing while requests failed, so only an
+        operator/controller decision brings it back)."""
+        with self._lock:
+            h = self._handles.get(name)
+            if h is None:
+                raise KeyError(f"no replica {name!r}")
+            h.auto_rejoin = True
+            h.consecutive_failures = 0
+            if h.state == "dead" and not h.replica.is_dead():
+                h.state = "up"
+                self._update_gauges_locked()
+                rejoined = True
+            else:
+                rejoined = False
+        if rejoined:
+            self.metrics.record_rejoin()
+
+    # -- hot-swap hook (driven by swap.ModelVersionManager) ---------------
+    def swap_replica(self, name: str, version, *,
+                     canary: bool = False) -> None:
+        """Drain → load ``version`` → rejoin for one replica. The replica
+        is unroutable for the duration (new traffic fails over); a load
+        failure rejoins it on the old version and re-raises
+        :class:`~dcnn_tpu.serve.replica.SwapError`."""
+        with self._lock:
+            h = self._handles.get(name)
+            if h is None:
+                raise KeyError(f"no replica {name!r}")
+            if h.state == "dead":
+                raise ReplicaDeadError(f"replica {name!r} is dead")
+            h.state = "unroutable"
+            self._update_gauges_locked()
+        try:
+            h.replica.swap(version)
+        except Exception:
+            self.metrics.record_swap(ok=False)
+            if h.replica.is_dead():
+                # through _note_dead so the death is COUNTED — a replica
+                # lost mid-swap must show on serve_router_replica_deaths
+                self._note_dead(h, "died during version swap")
+            else:
+                with self._lock:
+                    if h.state == "unroutable":
+                        h.state = "up"  # rejoined on the old version
+                    self._update_gauges_locked()
+            raise
+        with self._lock:
+            h.state = "up"
+            h.canary = canary
+            h.consecutive_failures = 0
+            self._update_gauges_locked()
+        self.metrics.record_swap(ok=True)
+
+    def set_canary(self, name: str, canary: bool) -> None:
+        with self._lock:
+            h = self._handles.get(name)
+            if h is not None:
+                h.canary = canary
+                self._update_gauges_locked()
+
+    def replica_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica router-side accounting — the feed the version
+        manager judges canaries on."""
+        with self._lock:
+            return {h.name: {
+                "state": h.state,
+                "canary": h.canary,
+                "version": h.replica.version,
+                "outstanding": h.outstanding,
+                "completed": h.completed,
+                "failed": h.failed,
+                "consecutive_failures": h.consecutive_failures,
+                "ewma_ms": h.ewma_ms,
+            } for h in self._handles.values()}
+
+    # -- health / telemetry ------------------------------------------------
+    def health_reason(self) -> Optional[str]:
+        """``None`` while the router can serve: not draining, and at
+        least ``min_routable`` replicas routable."""
+        with self._lock:
+            if self._closing:
+                return "draining or shut down: not accepting requests"
+            routable = sum(1 for h in self._handles.values()
+                           if h.state == "up")
+        if routable < self.min_routable:
+            return (f"degraded: {routable} routable replica(s), "
+                    f"need >= {self.min_routable}")
+        return None
+
+    def outstanding(self) -> int:
+        """Accepted-but-unresolved rows — the ledger sweep tests assert
+        this returns to 0 (nothing silently dropped)."""
+        with self._lock:
+            return self._outstanding
+
+    def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """The router's own scrape surface: ``/metrics`` =
+        ``RouterMetrics.prometheus()``, ``/healthz`` runs a live
+        :meth:`check_replicas` sweep then applies :meth:`health_reason`
+        (a scrape sees a dead replica the moment it is scraped, not at
+        the next sweep), ``/snapshot`` adds per-replica stats."""
+        from ..obs.server import TelemetryServer
+
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
+
+        def _check() -> Optional[str]:
+            self.check_replicas()
+            return self.health_reason()
+
+        srv = TelemetryServer(registry=self.metrics.registry,
+                              metrics_text=self.metrics.prometheus,
+                              host=host, port=port)
+        srv.add_check("router", _check)
+        srv.add_snapshot("router", self.metrics.snapshot)
+        srv.add_snapshot("replicas", self.replica_stats)
+        self._telemetry = srv.start()
+        return srv
+
+    # -- teardown ----------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop intake; wait for the accepted ledger to empty (replicas
+        keep dispatching their queues). On timeout the remaining ledger
+        is failed typed — never orphaned — and ``TimeoutError`` raises."""
+        with self._lock:
+            self._closing = True
+        deadline = (self._clock() + timeout) if timeout is not None else None
+        while True:
+            with self._lock:
+                if not self._ledger:
+                    return
+            if deadline is not None and self._clock() >= deadline:
+                break
+            self._sleep(0.005)
+        with self._lock:
+            pending = list(self._ledger)
+        exc = DrainingError(f"router drain timed out after {timeout}s")
+        for req in pending:
+            self._resolve_exc(req, exc)
+        raise TimeoutError(
+            f"router drain did not finish in {timeout}s "
+            f"({len(pending)} accepted request(s) failed typed)")
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """``drain=True`` completes the ledger first. Replicas are NOT
+        closed (the caller owns them) but the telemetry port is always
+        released."""
+        try:
+            if drain:
+                self.drain(timeout)
+            else:
+                with self._lock:
+                    self._closing = True
+                    pending = list(self._ledger)
+                exc = DrainingError("router shut down without drain")
+                for req in pending:
+                    self._resolve_exc(req, exc)
+        finally:
+            if self._telemetry is not None:
+                self._telemetry.stop()
+                self._telemetry = None
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    def __repr__(self) -> str:
+        with self._lock:
+            states = {h.name: h.state for h in self._handles.values()}
+        return f"Router({self.name!r}, replicas={states})"

@@ -1,0 +1,968 @@
+"""Router-tier tests (dcnn_tpu/serve/router.py + replica.py + swap.py).
+
+Contracts:
+
+- **No silent drops** (acceptance): every request the router *accepts*
+  (enters the ledger) resolves — with the result, or with a typed error.
+  Asserted by a ledger sweep after every scenario, including the chaos
+  test that kills a replica mid-soak via an armed FaultPlan.
+- **Priority admission**: low-priority requests shed first under load
+  (class shares over the fleet's aggregate batcher capacity), and a
+  router shed is a ``QueueFullError`` — the open-loop generator and all
+  existing backpressure handlers work unchanged.
+- **Death / rejoin**: a dead replica (injected crash, direct kill, TCP
+  connection close, last-heard timeout) is ejected; its
+  accepted-but-unanswered requests are re-admitted to survivors; a
+  restarted replica rejoins on the next sweep.
+- **Hot-swap / canary / rollback** (acceptance): a canary rollout serves
+  mixed-version traffic with zero shed increase and auto-promotes on
+  clean metrics; a deliberately degraded canary (injected error rate)
+  triggers instant rollback with the fleet converging back — all driven
+  by fake clocks, sleep-free.
+
+Replicas here wrap a jax-free ``FakeEngine`` (the batcher only needs
+``input_shape``/``max_batch``/``pad_to_bucket``/``run_padded``), so the
+whole protocol suite runs in milliseconds; bit-identity of hot-swap over
+REAL engines + CheckpointManager commits lives in tests/test_swap.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.resilience.faults import (
+    FaultPlan, InjectedCrash, InjectedFault, install, clear,
+)
+from dcnn_tpu.serve import (
+    LocalReplica, ModelVersionManager, NoReplicasError, QueueFullError,
+    ReplicaDeadError, ReplicaServer, Router, RouterMetrics,
+    RouterShedError, SwapError, TcpReplica, open_loop,
+)
+from dcnn_tpu.serve.batcher import DrainingError
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeEngine:
+    """Batcher-compatible engine without jax: logits = x + version, so a
+    result proves WHICH model version served it."""
+
+    def __init__(self, version=1, name="fake"):
+        self.input_shape = (4,)
+        self.max_batch = 8
+        self.bucket_sizes = [1, 2, 4, 8]
+        self.name = name
+        self.version = version
+        self.batch_invariant = True
+
+    def bucket_for(self, n):
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        raise ValueError(n)
+
+    def pad_to_bucket(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if b > n:
+            x = np.concatenate([x, np.zeros((b - n, 4), np.float32)])
+        return x, n
+
+    def run_padded(self, x):
+        return np.asarray(x, np.float32) + self.version
+
+
+class FakeFactory:
+    """EngineFactory stand-in: ``newest()`` is a settable attribute, and
+    every built engine encodes its version in its outputs."""
+
+    def __init__(self, newest_version=1):
+        self.newest_version = newest_version
+        self.built = []
+
+    def newest(self):
+        return self.newest_version
+
+    def __call__(self, version):
+        self.built.append(version)
+        return FakeEngine(version)
+
+
+def make_fleet(n=3, *, version=1, queue_capacity=16, clock=None,
+               shares=None, max_readmits=3, failure_eject_threshold=0):
+    """(router, replicas, plans, clock) — start=False replicas pumped by
+    hand, router backoff sleeps advance the fake clock."""
+    fc = clock if clock is not None else FakeClock()
+    factory = FakeFactory(newest_version=version)
+    plans, reps = {}, []
+    for i in range(n):
+        plans[f"r{i}"] = FaultPlan()
+        reps.append(LocalReplica(
+            factory, version, name=f"r{i}", queue_capacity=queue_capacity,
+            clock=fc, fault_plan=plans[f"r{i}"], start=False))
+    router = Router(reps, clock=fc, sleep=lambda s: fc.advance(s),
+                    shares=shares, max_readmits=max_readmits,
+                    failure_eject_threshold=failure_eject_threshold)
+    return router, reps, plans, fc
+
+
+def pump(reps, rounds=4):
+    """Dispatch every queued request, including re-admissions landing on
+    other replicas mid-round."""
+    for _ in range(rounds):
+        for r in reps:
+            while r.step():
+                pass
+
+
+# ------------------------------------------------------------- basic routing
+
+def test_router_results_match_and_distribute():
+    router, reps, _, _ = make_fleet(3)
+    futs = [router.submit(np.full((4,), i, np.float32)) for i in range(12)]
+    pump(reps)
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(timeout=0),
+                                      np.full((4,), i + 1, np.float32))
+    assert router.outstanding() == 0  # ledger swept
+    stats = router.replica_stats()
+    # least-loaded routing spreads 12 singles over 3 replicas
+    assert all(st["completed"] >= 1 for st in stats.values())
+    assert sum(st["completed"] for st in stats.values()) == 12
+
+
+def test_router_batch_requests_and_single_shape():
+    router, reps, _, _ = make_fleet(2)
+    fb = router.submit(np.zeros((3, 4), np.float32))
+    fs = router.submit(np.zeros((4,), np.float32))
+    pump(reps)
+    assert fb.result(0).shape == (3, 4)
+    assert fs.result(0).shape == (4,)  # single in, single out
+
+
+def test_router_unknown_priority_raises():
+    router, _, _, _ = make_fleet(1)
+    with pytest.raises(ValueError, match="unknown priority"):
+        router.submit(np.zeros(4, np.float32), priority="urgent")
+
+
+def test_router_submit_after_shutdown_is_typed():
+    router, reps, _, _ = make_fleet(1)
+    router.shutdown(drain=False)
+    with pytest.raises(DrainingError):
+        router.submit(np.zeros(4, np.float32))
+
+
+# ------------------------------------------------------- priority admission
+
+def test_low_priority_sheds_first_under_load():
+    """ACCEPTANCE (SLO admission): with the fleet substantially
+    committed, low is shed while normal and high still admit; with the
+    fleet nearly full only high admits. Per-class counters record it."""
+    router, reps, _, _ = make_fleet(
+        2, queue_capacity=8,
+        shares={"high": 1.0, "normal": 0.85, "low": 0.6})
+    cap = 16
+
+    # fill to 10/16 rows (62.5% > low's 60% share; < normal's 85%)
+    held = [router.submit(np.zeros((2, 4), np.float32)) for _ in range(5)]
+    assert router.outstanding() == 10
+    with pytest.raises(RouterShedError):
+        router.submit(np.zeros(4, np.float32), priority="low")
+    ok_n = router.submit(np.zeros(4, np.float32), priority="normal")
+    ok_h = router.submit(np.zeros(4, np.float32), priority="high")
+
+    # fill to 14/16 (87.5% > normal's 85% share) — only high admits
+    more = [router.submit(np.zeros(4, np.float32), priority="high")
+            for _ in range(2)]
+    with pytest.raises(RouterShedError):
+        router.submit(np.zeros(4, np.float32), priority="normal")
+    ok_h2 = router.submit(np.zeros(4, np.float32), priority="high")
+
+    pump(reps)
+    for f in held + more + [ok_n, ok_h, ok_h2]:
+        assert f.exception(timeout=0) is None
+    snap = router.metrics.snapshot()
+    assert snap["low"]["shed"] == 1 and snap["low"]["completed"] == 0
+    assert snap["normal"]["shed"] == 1
+    assert snap["high"]["shed"] == 0 and snap["high"]["completed"] == 4
+    assert snap["total"]["shed_fraction"] > 0
+    assert router.outstanding() == 0
+    assert router.metrics.capacity_rows.value == cap
+
+
+def test_shed_is_queue_full_error_for_open_loop():
+    """RouterShedError must subclass QueueFullError so the shared
+    open-loop generator (and every existing handler) absorbs router
+    backpressure identically."""
+    assert issubclass(RouterShedError, QueueFullError)
+
+
+def test_every_replica_full_sheds_and_unadmits():
+    """Aggregate admission can pass while every individual batcher is
+    full — the router must shed (typed) and UN-admit: ledger and
+    outstanding return to their prior values, and the request counts
+    ONLY as shed (never double-counted in offered traffic)."""
+    router, reps, _, _ = make_fleet(2, queue_capacity=4)
+    held = [router.submit(np.zeros((3, 4), np.float32)) for _ in range(2)]
+    assert router.outstanding() == 6  # 3 rows on each replica (cap 8)
+    with pytest.raises(RouterShedError):
+        # admission: 6+2=8 <= 8 OK; but each replica has 3/4 used — a
+        # 2-row request fits neither
+        router.submit(np.zeros((2, 4), np.float32))
+    assert router.outstanding() == 6
+    snap = router.metrics.snapshot()["normal"]
+    assert snap["requests"] == 6 and snap["shed"] == 2  # rows, not 8/2
+    pump(reps)
+    assert router.outstanding() == 0
+    for f in held:
+        assert f.exception(timeout=0) is None
+
+
+def test_cancelled_then_failed_request_retires_ledger():
+    """A caller-cancelled future whose replica-side request then FAILS
+    must still leave the ledger (the cancel resolved it; the settle must
+    not leak outstanding rows)."""
+    router, reps, plans, _ = make_fleet(1)
+    plans["r0"].arm("serve.replica_infer", exc=InjectedFault, times=1)
+    f = router.submit(np.zeros(4, np.float32))
+    assert f.cancel()  # resolved by the caller while queued
+    pump(reps)
+    assert router.outstanding() == 0
+    f2 = router.submit(np.zeros(4, np.float32))  # capacity not poisoned
+    pump(reps)
+    assert f2.exception(timeout=0) is None
+
+
+# ------------------------------------------------------ death + re-admission
+
+def test_kill_reroutes_queued_requests_to_survivors():
+    router, reps, _, _ = make_fleet(3)
+    futs = [router.submit(np.full((4,), i, np.float32)) for i in range(9)]
+    reps[0].kill()           # 3 queued requests die with the replica
+    router.check_replicas()  # eject
+    pump(reps)
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(timeout=0),
+                                      np.full((4,), i + 1, np.float32))
+    assert router.outstanding() == 0
+    assert router.replica_stats()["r0"]["state"] == "dead"
+    assert router.metrics.registry.snapshot()[
+        "serve_router_replica_deaths_total"] == 1
+
+
+def test_all_replicas_dead_fails_typed_not_silent():
+    router, reps, _, _ = make_fleet(2, max_readmits=1)
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(4)]
+    for r in reps:
+        r.kill()
+    router.check_replicas()
+    for f in futs:
+        with pytest.raises((ReplicaDeadError, NoReplicasError)):
+            f.result(timeout=0)
+    assert router.outstanding() == 0  # failed TYPED, ledger swept
+    with pytest.raises(RouterShedError):
+        router.submit(np.zeros(4, np.float32))  # capacity is 0 now
+    assert router.health_reason() is not None  # degraded
+
+
+def test_restarted_replica_rejoins_and_serves():
+    router, reps, _, _ = make_fleet(2)
+    reps[1].kill()
+    router.check_replicas()
+    assert router.replica_stats()["r1"]["state"] == "dead"
+    reps[1].restart()
+    report = router.check_replicas()
+    assert report["r1"] == "rejoined"
+    assert router.replica_stats()["r1"]["state"] == "up"
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(8)]
+    pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    assert router.replica_stats()["r1"]["completed"] >= 1  # really serving
+    assert router.metrics.registry.snapshot()[
+        "serve_router_rejoins_total"] == 1
+
+
+def test_chaos_faultplan_kill_mid_open_loop_soak():
+    """ACCEPTANCE (chaos): open-loop load, a FaultPlan-injected replica
+    crash mid-soak. Every accepted request completes or fails with a
+    typed error (ledger sweep), survivors absorb the load, and the dead
+    replica rejoins after restart — fully sleep-free."""
+    fc = FakeClock()
+    router, reps, plans, _ = make_fleet(3, queue_capacity=64, clock=fc)
+    # the victim's 20th dispatch is an InjectedCrash = process death
+    plans["r1"].arm("serve.replica_infer", at=19, exc=InjectedCrash)
+
+    ticks = {"n": 0}
+
+    def soak_sleep(dt):
+        # open_loop pacing hook: advance virtual time, pump dispatch,
+        # run the router's liveness sweep every ~10 ticks
+        fc.advance(dt)
+        pump(reps, rounds=1)
+        ticks["n"] += 1
+        if ticks["n"] % 10 == 0:
+            router.check_replicas()
+
+    samples = [np.full((4,), i, np.float32) for i in range(16)]
+    futs = open_loop(router, samples, offered_rps=200.0, seconds=1.0,
+                     clock=fc, sleep=soak_sleep)
+    router.check_replicas()
+    pump(reps)
+    router.check_replicas()  # late crash detection
+    pump(reps)
+
+    assert len(futs) > 100          # the load was really offered
+    accepted = len(futs)
+    completed = failed = 0
+    for i, f in futs:
+        assert f.done(), "accepted request neither completed nor failed"
+        if f.exception() is None:
+            np.testing.assert_array_equal(
+                f.result(), np.asarray(samples[i]) + 1.0)
+            completed += 1
+        else:
+            assert isinstance(f.exception(),
+                              (ReplicaDeadError, NoReplicasError))
+            failed += 1
+    assert router.outstanding() == 0  # accepted-ledger swept clean
+    # the crash kills at most the in-flight batch; everything else is
+    # re-admitted to survivors
+    assert completed >= accepted - 8
+    stats = router.replica_stats()
+    assert stats["r1"]["state"] == "dead"
+    assert stats["r0"]["completed"] + stats["r2"]["completed"] >= \
+        completed - stats["r1"]["completed"]
+    # restart: the replica rejoins and serves again
+    reps[1].restart()
+    assert router.check_replicas()["r1"] == "rejoined"
+    f = router.submit(np.zeros(4, np.float32))
+    pump(reps)
+    assert f.exception(timeout=0) is None
+
+
+def test_transient_replica_fault_is_retried_elsewhere():
+    """An InjectedFault (one failing request, replica stays up) is
+    re-admitted to another replica — user-invisible; the failure is
+    counted against the replica for the canary judge."""
+    router, reps, plans, _ = make_fleet(2)
+    plans["r0"].arm("serve.replica_infer", exc=InjectedFault, times=1)
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(4)]
+    pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    stats = router.replica_stats()
+    assert stats["r0"]["failed"] >= 1 and stats["r0"]["state"] == "up"
+    assert router.metrics.registry.snapshot()[
+        "serve_router_readmits_total"] >= 1
+
+
+def test_failure_eject_threshold():
+    """A replica that answers health but fails every request is ejected
+    once its consecutive-failure run crosses the threshold — and the
+    liveness sweep must NOT flap it back in (its health probe was lying);
+    only an explicit rejoin() re-admits it."""
+    router, reps, plans, _ = make_fleet(2, failure_eject_threshold=3)
+    plans["r0"].arm("serve.replica_infer", exc=InjectedFault)  # always
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(12)]
+    pump(reps, rounds=6)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    assert router.replica_stats()["r0"]["state"] == "dead"
+    report = router.check_replicas()  # health passes, but no auto-rejoin
+    assert "explicit rejoin" in report["r0"]
+    assert router.replica_stats()["r0"]["state"] == "dead"
+    plans["r0"].disarm("serve.replica_infer")
+    router.rejoin("r0")
+    assert router.replica_stats()["r0"]["state"] == "up"
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(6)]
+    pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    assert router.replica_stats()["r0"]["completed"] >= 1
+
+
+def test_malformed_request_unadmits_no_ledger_leak():
+    """A request the replica's own validation rejects (e.g. oversized
+    batch) propagates to the CALLER — and is un-admitted: the ledger and
+    outstanding count are restored, so bad requests can't poison
+    admission capacity or hang drain()."""
+    router, reps, _, _ = make_fleet(2, queue_capacity=64)
+    with pytest.raises(ValueError, match="outside"):
+        router.submit(np.zeros((9, 4), np.float32))  # > max_batch 8
+    assert router.outstanding() == 0
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(4)]
+    pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    assert router.outstanding() == 0
+
+
+def test_serve_route_fault_point():
+    plan = FaultPlan().arm("serve.route", at=1, times=1)
+    router, reps, _, _ = make_fleet(1)
+    install(plan)
+    try:
+        router.submit(np.zeros(4, np.float32))       # invocation 0: clean
+        with pytest.raises(InjectedFault):
+            router.submit(np.zeros(4, np.float32))   # invocation 1: boom
+    finally:
+        clear()
+    pump(reps)
+    assert router.outstanding() == 0
+
+
+# ------------------------------------------------------------------ hot-swap
+
+def test_swap_replica_drain_load_rejoin():
+    router, reps, _, _ = make_fleet(2, version=1)
+    router.swap_replica("r0", 2)
+    stats = router.replica_stats()
+    assert stats["r0"]["version"] == 2 and stats["r0"]["state"] == "up"
+    assert stats["r1"]["version"] == 1
+    # mixed-version fleet serves; results prove which version answered
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(8)]
+    pump(reps)
+    served = {float(f.result(timeout=0)[0]) for f in futs}
+    assert served <= {1.0, 2.0} and len(served) == 2
+    assert router.metrics.registry.snapshot()[
+        "serve_router_swaps_total"] == 1
+
+
+def test_swap_failure_rejoins_old_version():
+    router, reps, plans, _ = make_fleet(1, version=1)
+    plans["r0"].arm("serve.swap", exc=InjectedFault, times=1)
+    with pytest.raises(SwapError):
+        router.swap_replica("r0", 2)
+    stats = router.replica_stats()
+    assert stats["r0"]["version"] == 1 and stats["r0"]["state"] == "up"
+    f = router.submit(np.zeros(4, np.float32))
+    pump(reps)
+    assert float(f.result(timeout=0)[0]) == 1.0  # old version serving
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_swap_failures_total"] == 1
+    assert snap["serve_router_swaps_total"] == 0
+
+
+def test_mvm_canary_then_promote_zero_shed():
+    """ACCEPTANCE (hot-swap, clean path): canary rollout serves
+    mixed-version traffic with zero shed, then auto-promotes on a clean
+    observation window — fake clock, sleep-free."""
+    fc = FakeClock()
+    router, reps, _, _ = make_fleet(4, version=1, clock=fc)
+    factory = FakeFactory(newest_version=1)
+    mvm = ModelVersionManager(router, factory, canary_fraction=0.25,
+                              observe_s=10.0, min_canary_requests=5,
+                              clock=fc)
+    assert mvm.poll()["action"] == "none"
+
+    factory.newest_version = 2
+    res = mvm.poll()
+    assert res["action"] == "canary" and len(res["canaries"]) == 1
+    canary = res["canaries"][0]
+    assert router.replica_stats()[canary]["version"] == 2
+    assert router.metrics.canary_replicas.value == 1
+
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(24)]
+    pump(reps)
+    served = {float(f.result(timeout=0)[0]) for f in futs}
+    assert served == {1.0, 2.0}  # mixed-version traffic really happened
+    assert router.metrics.snapshot()["total"]["shed"] == 0  # zero shed
+
+    assert mvm.poll()["action"] == "canary_wait"  # window not elapsed
+    fc.advance(11.0)
+    res = mvm.poll()
+    assert res["action"] == "promoted"
+    assert all(st["version"] == 2
+               for st in router.replica_stats().values())
+    assert mvm.current_version == 2 and mvm.state == "idle"
+    assert router.metrics.canary_replicas.value == 0
+    assert router.metrics.registry.snapshot()[
+        "serve_router_promotions_total"] == 1
+
+
+def test_mvm_degraded_canary_instant_rollback():
+    """ACCEPTANCE (hot-swap, regression path): a deliberately degraded
+    canary (injected error rate) triggers instant rollback; the fleet
+    converges back to the old version; the bad version is quarantined
+    and never auto-retried; end users see zero failures."""
+    fc = FakeClock()
+    router, reps, plans, _ = make_fleet(4, version=1, clock=fc)
+    factory = FakeFactory(newest_version=2)
+    mvm = ModelVersionManager(router, factory, canary_fraction=0.25,
+                              observe_s=10.0, min_canary_requests=5,
+                              max_error_delta=0.02, clock=fc)
+    res = mvm.poll()
+    assert res["action"] == "canary"
+    canary = res["canaries"][0]
+    plans[canary].arm("serve.replica_infer", exc=InjectedFault)  # degrade
+
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(32)]
+    pump(reps, rounds=6)
+    assert all(f.exception(timeout=0) is None for f in futs)  # users fine
+
+    res = mvm.poll()
+    assert res["action"] == "rolled_back"
+    assert "error ratio" in res["reason"]
+    plans[canary].disarm("serve.replica_infer")
+    assert all(st["version"] == 1
+               for st in router.replica_stats().values())  # converged back
+    assert mvm.current_version == 1 and mvm.quarantined == {2}
+    assert router.metrics.registry.snapshot()[
+        "serve_router_rollbacks_total"] == 1
+    assert mvm.poll()["action"] == "none"  # quarantined: no re-canary
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(8)]
+    pump(reps)
+    assert {float(f.result(timeout=0)[0]) for f in futs} == {1.0}
+
+
+def test_mvm_single_transient_canary_failure_no_rollback():
+    """One transient failure on a canary's first request must NOT
+    quarantine the version (min_error_samples floor): the canary stays,
+    and with clean traffic afterwards the version still promotes."""
+    fc = FakeClock()
+    router, reps, plans, _ = make_fleet(4, version=1, clock=fc)
+    factory = FakeFactory(newest_version=2)
+    mvm = ModelVersionManager(router, factory, canary_fraction=0.25,
+                              observe_s=10.0, min_canary_requests=5,
+                              min_error_samples=5, clock=fc)
+    res = mvm.poll()
+    canary = res["canaries"][0]
+    plans[canary].arm("serve.replica_infer", exc=InjectedFault, times=1)
+    f = router.submit(np.zeros(4, np.float32))
+    pump(reps)
+    assert f.exception(timeout=0) is None  # re-admitted elsewhere
+    assert mvm.poll()["action"] == "canary_wait"  # 1 failure < floor
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(24)]
+    pump(reps)
+    assert all(fu.exception(timeout=0) is None for fu in futs)
+    fc.advance(11.0)
+    assert mvm.poll()["action"] == "promoted"
+    assert mvm.quarantined == set()
+
+
+def test_mvm_reconciles_replica_that_missed_promote():
+    """A replica dead through a promote rejoins serving the pre-promote
+    version; the idle watch heals it to current instead of leaving the
+    fleet mixed-version forever."""
+    fc = FakeClock()
+    router, reps, _, _ = make_fleet(4, version=1, clock=fc)
+    factory = FakeFactory(newest_version=2)
+    mvm = ModelVersionManager(router, factory, canary_fraction=0.25,
+                              observe_s=1.0, min_canary_requests=2,
+                              clock=fc)
+    res = mvm.poll()
+    assert res["action"] == "canary"
+    reps[3].kill()              # misses the whole rollout
+    router.check_replicas()
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(8)]
+    pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    fc.advance(2.0)
+    assert mvm.poll()["action"] == "promoted"
+    reps[3].restart()
+    assert router.check_replicas()["r3"] == "rejoined"
+    assert router.replica_stats()["r3"]["version"] == 1  # stale!
+    res = mvm.poll()
+    assert res["action"] == "reconciled" and res["reconciled"] == ["r3"]
+    assert all(st["version"] == 2
+               for st in router.replica_stats().values())
+    assert mvm.poll()["action"] == "none"  # converged: nothing to heal
+
+
+def test_mvm_unloadable_version_quarantined():
+    """A version whose engine cannot even load (serve.swap fault) is
+    quarantined at canary time; the fleet stays on the old version."""
+    fc = FakeClock()
+    router, reps, plans, _ = make_fleet(2, version=1, clock=fc)
+    factory = FakeFactory(newest_version=2)
+    for p in plans.values():
+        p.arm("serve.swap", exc=InjectedFault, times=1)
+    mvm = ModelVersionManager(router, factory, clock=fc)
+    res = mvm.poll()
+    assert res["action"] == "swap_failed"
+    assert mvm.quarantined == {2} and mvm.state == "idle"
+    assert all(st["version"] == 1
+               for st in router.replica_stats().values())
+    assert mvm.poll()["action"] == "none"
+
+
+# ----------------------------------------------------------------- TCP tier
+
+@pytest.fixture()
+def tcp_pair():
+    backend = LocalReplica(FakeEngine(version=7), name="backend",
+                           queue_capacity=32, max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    client = TcpReplica("127.0.0.1", server.port, name="remote")
+    yield backend, server, client
+    client.close()
+    server.close()
+    backend.close()
+
+
+def test_tcp_replica_end_to_end(tcp_pair):
+    backend, server, client = tcp_pair
+    futs = [client.submit(np.full((4,), i, np.float32)) for i in range(6)]
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(timeout=30),
+                                      np.full((4,), i + 7, np.float32))
+    # pong metadata populated the remote identity
+    client.ping()
+    deadline = time.monotonic() + 10
+    while client.version is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert client.version == 7
+    assert client.input_shape == (4,)
+    assert client.health() is None and not client.is_dead()
+    st = client.stats()
+    assert st["version"] == 7 and st["state"] == "up"
+
+
+def test_tcp_replica_connection_close_fails_pending(tcp_pair):
+    """Replica-process death = connection close: pending request futures
+    fail with ReplicaDeadError (typed, re-admittable) and the client
+    reports dead — immediately, not by timeout."""
+    backend, server, client = tcp_pair
+    backend.kill()  # server-side batcher gone: queued work errors back
+    server.close()  # and the host closes its sockets
+    deadline = time.monotonic() + 10
+    while not client.is_dead() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert client.is_dead()
+    with pytest.raises(ReplicaDeadError):
+        client.submit(np.zeros(4, np.float32))
+
+
+def test_tcp_replica_last_heard_timeout():
+    """The partitioned-but-open case: silence past the window makes
+    health() PROBE first (never convicting an idle-but-healthy replica),
+    then escalate to dead once the probe itself goes unanswered for the
+    window — sleep-free via a fake clock, with the 'network' black-holed
+    by dropping sends."""
+    backend = LocalReplica(FakeEngine(), name="backend", queue_capacity=8,
+                           max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    fc = FakeClock(100.0)
+    client = TcpReplica("127.0.0.1", server.port, name="remote",
+                        timeout_s=5.0, clock=fc)
+    try:
+        deadline = time.monotonic() + 10
+        while client.version is None and time.monotonic() < deadline:
+            time.sleep(0.005)  # initial ping answered: last_heard fresh
+        assert client.health() is None
+        # black-hole the link: frames leave but never arrive anywhere
+        client._chan.send = lambda *a, **k: None
+        fc.advance(6.0)          # idle past the window
+        assert client.health() is None   # asks (ping), does NOT convict
+        assert not client.is_dead()
+        fc.advance(6.0)          # the probe itself went unanswered
+        reason = client.health()
+        assert reason is not None and "unresponsive" in reason
+        assert client.is_dead()
+    finally:
+        client.close()
+        server.close()
+        backend.close()
+
+
+def test_router_sweep_convicts_partitioned_tcp_replica():
+    """Through the ROUTER's own sweep (ping-then-health every pass): a
+    partitioned-but-open TCP replica is convicted on the second sweep —
+    the sweep's fresh ping must not rewind the probe clock (the first
+    probe since the last frame is the one that counts)."""
+    backend = LocalReplica(FakeEngine(), name="backend", queue_capacity=8,
+                           max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    fc = FakeClock(100.0)
+    client = TcpReplica("127.0.0.1", server.port, name="tcp0",
+                        timeout_s=5.0, clock=fc)
+    local = LocalReplica(FakeEngine(), name="local0", queue_capacity=8,
+                         max_wait_ms=0.0)
+    router = Router([client, local])
+    try:
+        deadline = time.monotonic() + 10
+        while client.version is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert router.check_replicas()["tcp0"] == "up"
+        client._chan.send = lambda *a, **k: None  # black-hole the link
+        fc.advance(6.0)
+        router.check_replicas()     # probes (clock not rewound)
+        assert not client.is_dead()
+        fc.advance(6.0)
+        report = router.check_replicas()  # probe unanswered: convict
+        assert client.is_dead()
+        assert "ejected" in report["tcp0"]
+        assert router.replica_stats()["tcp0"]["state"] == "dead"
+    finally:
+        router.shutdown(drain=False)
+        client.close()
+        server.close()
+        backend.close()
+        local.close()
+
+
+def test_tcp_replica_slow_sweep_does_not_false_eject():
+    """A sweep cadence slower than timeout_s must NOT kill a healthy
+    idle replica: the probe the first health() sends is answered, so the
+    next look sees a fresh frame."""
+    backend = LocalReplica(FakeEngine(), name="backend", queue_capacity=8,
+                           max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    fc = FakeClock(100.0)
+    client = TcpReplica("127.0.0.1", server.port, name="remote",
+                        timeout_s=2.0, clock=fc)
+    try:
+        deadline = time.monotonic() + 10
+        while client.version is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        for _ in range(3):       # sweeps spaced 3x the timeout window
+            fc.advance(6.0)
+            client.health()      # probes; the live server answers
+            deadline = time.monotonic() + 10
+            # wait for the pong to land so last_heard refreshes
+            while time.monotonic() < deadline:
+                with client._lock:
+                    if client._last_heard >= fc() - 0.1:
+                        break
+                time.sleep(0.005)
+            assert client.health() is None
+            assert not client.is_dead()
+    finally:
+        client.close()
+        server.close()
+        backend.close()
+
+
+def test_router_over_tcp_replicas_kill_and_failover():
+    """Router fronting one TCP + one local replica: killing the TCP
+    host mid-queue reroutes accepted work to the survivor."""
+    backend = LocalReplica(FakeEngine(version=1), name="backend",
+                           queue_capacity=32, max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    client = TcpReplica("127.0.0.1", server.port, name="tcp0")
+    local = LocalReplica(FakeEngine(version=1), name="local0",
+                         queue_capacity=32, max_wait_ms=0.0)
+    router = Router([client, local])
+    try:
+        futs = [router.submit(np.full((4,), i, np.float32))
+                for i in range(12)]
+        server.close()  # the TCP host dies mid-traffic
+        backend.kill()
+        router.check_replicas()
+        for i, f in enumerate(futs):
+            exc = None
+            try:
+                y = f.result(timeout=30)
+                np.testing.assert_array_equal(
+                    y, np.full((4,), i + 1, np.float32))
+            except (ReplicaDeadError, NoReplicasError) as e:
+                exc = e  # typed — acceptable for in-flight rows
+            assert f.done() and (exc is None or f.exception() is exc)
+        deadline = time.monotonic() + 10
+        while router.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert router.outstanding() == 0
+        assert router.replica_stats()["tcp0"]["state"] == "dead"
+    finally:
+        router.shutdown(drain=False)
+        client.close()
+        local.close()
+
+
+def test_tcp_remote_swap(tcp_pair):
+    """The swap command crosses the wire: a remote replica built on a
+    factory hot-swaps and serves the new version."""
+    backend = LocalReplica(FakeFactory(), 1, name="versioned",
+                           queue_capacity=8, max_wait_ms=0.0)
+    server = ReplicaServer(backend, port=0)
+    client = TcpReplica("127.0.0.1", server.port, name="remote2")
+    try:
+        f = client.submit(np.zeros(4, np.float32))
+        np.testing.assert_array_equal(f.result(timeout=30),
+                                      np.ones(4, np.float32))
+        client.swap(5, timeout=30)
+        f = client.submit(np.zeros(4, np.float32))
+        np.testing.assert_array_equal(f.result(timeout=30),
+                                      np.full((4,), 5.0, np.float32))
+    finally:
+        client.close()
+        server.close()
+        backend.close()
+
+
+# ----------------------------------------------------- telemetry + metrics
+
+def test_router_healthz_degrades_and_recovers():
+    import json
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+
+    router, reps, _, _ = make_fleet(2, queue_capacity=8)
+    srv = router.start_telemetry(port=0)
+    try:
+        with urlopen(f"{srv.url}/healthz", timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert resp.status == 200 and body["status"] == "ok"
+        assert body["flags"]["serve_router_replicas"] == 2
+
+        for r in reps:
+            r.kill()
+        # /healthz runs a live sweep: the scrape itself sees the deaths
+        with pytest.raises(HTTPError) as ei:
+            urlopen(f"{srv.url}/healthz", timeout=10)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert any("routable" in r for r in body["reasons"])
+        assert body["flags"]["serve_router_replicas_routable"] == 0
+
+        with urlopen(f"{srv.url}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "serve_router_replica_deaths_total 2" in text
+
+        for r in reps:
+            r.restart()
+        with urlopen(f"{srv.url}/healthz", timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+    finally:
+        router.shutdown(drain=False)  # stops the telemetry server
+
+
+def test_router_metrics_prometheus_conformance():
+    """Satellite: the new serve_router_* series render through the shared
+    exposition module — counters end _total with HELP/TYPE headers,
+    per-priority families all present, histograms carry cumulative
+    buckets ending +Inf."""
+    fc = FakeClock()
+    m = RouterMetrics(clock=fc)
+    m.record_submit("high", 2)
+    m.record_shed("low", 1)
+    m.record_done("high", 0.01, 2)
+    m.record_failed("normal", 1)
+    m.record_replica_death()
+    m.record_rollback()
+    text = m.prometheus()
+    lines = text.splitlines()
+    for p in ("high", "normal", "low"):
+        for family in (f"serve_router_requests_{p}_total",
+                       f"serve_router_shed_{p}_total",
+                       f"serve_router_completed_{p}_total",
+                       f"serve_router_failed_{p}_total",
+                       f"serve_router_latency_seconds_{p}"):
+            assert f"# TYPE {family}" in text, family
+    assert "serve_router_requests_high_total 2" in lines
+    assert "serve_router_shed_low_total 1" in lines
+    assert "serve_router_replica_deaths_total 1" in lines
+    assert "serve_router_rollbacks_total 1" in lines
+    # histogram family: cumulative buckets ending +Inf, _sum/_count pair
+    assert 'serve_router_latency_seconds_high_bucket{le="+Inf"} 1' in lines
+    assert "serve_router_latency_seconds_high_count 1" in lines
+    # derived windowed percentile gauges appear once data exists
+    assert "serve_router_latency_window_p99_ms_high 10.0" in lines
+    # counters never render without the _total suffix
+    for ln in lines:
+        if ln.startswith("# TYPE") and ln.endswith(" counter"):
+            assert ln.split()[2].endswith("_total"), ln
+
+
+def test_router_metrics_snapshot_totals():
+    fc = FakeClock()
+    m = RouterMetrics(clock=fc)
+    m.record_submit("normal", 3)
+    m.record_done("normal", 0.002, 3)
+    m.record_shed("low", 2)
+    fc.advance(1.0)
+    s = m.snapshot()
+    assert s["normal"]["completed"] == 3
+    assert s["normal"]["p50_ms"] == pytest.approx(2.0)
+    assert s["low"]["shed"] == 2 and s["low"]["p50_ms"] is None
+    assert s["total"]["shed_fraction"] == pytest.approx(2 / 5)
+    assert s["total"]["throughput_rps"] == pytest.approx(3.0)
+
+
+def test_bench_router_section_structure():
+    """bench.py's router block over injected jax-free engines: the
+    BENCH_SERVE=1 acceptance shape — capacity probe (1 vs N + scaling),
+    >= 3-point latency-vs-load curve, and the kill-a-replica sub-soak
+    with availability + silent-drop accounting. Sub-second windows."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench
+
+    class SlowFakeEngine(FakeEngine):
+        # a ~0.3ms dispatch bounds the fake's capacity so the open-loop
+        # phases offer a sane rate (a zero-cost engine would make the
+        # load loop iterate offered_rps x seconds ~ millions of times)
+        def run_padded(self, x):
+            time.sleep(3e-4)
+            return super().run_padded(x)
+
+    engines = [SlowFakeEngine(version=1, name=f"e{i}") for i in range(2)]
+    doc = bench.router_section(None, engines=engines, seconds=0.2)
+    assert doc["replicas"] == 2
+    assert doc["capacity_1_img_per_sec"] > 0
+    assert doc["capacity_img_per_sec"] > 0
+    assert doc["capacity_scaling_x"] is not None
+    assert len(doc["loads"]) >= 3
+    for pt in doc["loads"]:
+        assert set(pt) >= {"offered_img_per_sec", "achieved_rps",
+                           "p50_ms", "p99_ms", "shed_fraction"}
+    ks = doc["kill_soak"]
+    assert ks["accepted"] == ks["completed"] + ks["typed_failures"]
+    assert ks["silently_dropped"] == 0
+    assert ks["replica_deaths"] == 1
+    assert ks["rejoined_after_restart"] is True
+    assert ks["availability"] is not None and ks["availability"] > 0.9
+
+
+def test_serve_router_example_imports():
+    """Import smoke for examples/serve_router.py (no main() execution),
+    with the examples dir resolving its `common` module."""
+    import importlib
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ex_dir = os.path.join(repo, "examples")
+    saved_common = sys.modules.pop("common", None)
+    sys.path.insert(0, ex_dir)
+    try:
+        mod = importlib.import_module("serve_router")
+        assert callable(mod.main)
+        assert callable(mod.build_versions)
+    finally:
+        sys.path.remove(ex_dir)
+        sys.modules.pop("serve_router", None)
+        sys.modules.pop("common", None)
+        if saved_common is not None:
+            sys.modules["common"] = saved_common
+
+
+def test_router_drain_completes_ledger():
+    fc = FakeClock()
+    router, reps, _, _ = make_fleet(2, clock=fc)
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(6)]
+
+    # drain's wait loop runs on the injected sleep: pump the replicas
+    # from inside it so the ledger empties (sleep-free)
+    def pump_sleep(dt):
+        fc.advance(dt)
+        pump(reps, rounds=1)
+
+    router._sleep = pump_sleep
+    router.drain(timeout=5.0)
+    assert all(f.exception(timeout=0) is None for f in futs)
+    with pytest.raises(DrainingError):
+        router.submit(np.zeros(4, np.float32))

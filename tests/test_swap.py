@@ -1,0 +1,174 @@
+"""Hot-swap over REAL engines + CheckpointManager commits
+(dcnn_tpu/serve/swap.py).
+
+Contracts (the engine-hot-swap-in-isolation satellite):
+
+- drain → load the newest checksum-valid ``CheckpointManager`` commit →
+  rejoin produces an engine **bit-identical to a freshly constructed
+  one at every serve bucket**;
+- a torn/corrupt newest commit is skipped to the previous valid version
+  — no crash, warned + counted (``serve_swap_versions_skipped_total``)
+  and, unlike the training-side restore, never renamed/quarantined (the
+  serving tier is a read-only consumer of the checkpoint root);
+- a ``serve.swap`` fault mid-load leaves the replica serving its OLD
+  version.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dcnn_tpu.nn import SequentialBuilder
+from dcnn_tpu.obs.registry import MetricsRegistry
+from dcnn_tpu.resilience.checkpoint import CheckpointManager, list_steps
+from dcnn_tpu.resilience.faults import FaultPlan, InjectedFault
+from dcnn_tpu.serve import (
+    EngineFactory, InferenceEngine, LocalReplica, SwapError,
+    newest_valid_version,
+)
+
+
+def _tiny_model():
+    return (SequentialBuilder(name="swp", data_format="NHWC")
+            .input((8, 8, 3))
+            .conv2d(4, 3, padding=1).batchnorm().activation("relu")
+            .maxpool2d(2).flatten().dense(5)
+            .build())
+
+
+@pytest.fixture(scope="module")
+def versions(tmp_path_factory):
+    """A checkpoint root with two committed versions of the tiny model —
+    step 1, and step 2 with visibly different params — plus a probe
+    batch."""
+    root = str(tmp_path_factory.mktemp("ckpts"))
+    model = _tiny_model()
+    params1, state = model.init(jax.random.PRNGKey(0), model.input_shape)
+    params2 = jax.tree_util.tree_map(lambda a: a + 0.25, params1)
+    mgr = CheckpointManager(root, keep=5)
+    mgr.save(1, model, params1, state)
+    mgr.save(2, model, params2, state)
+    mgr.close()
+    rng = np.random.default_rng(3)
+    pool = rng.normal(size=(8, 8, 8, 3)).astype(np.float32)
+    return root, model, (params1, params2), state, pool
+
+
+def test_newest_valid_version_picks_newest(versions):
+    root, *_ = versions
+    found = newest_valid_version(root)
+    assert found is not None
+    step, path = found
+    assert step == 2 and path.endswith("ckpt-00000002")
+
+
+def test_corrupt_newest_skipped_to_previous_valid(versions, tmp_path):
+    """ACCEPTANCE (satellite): a bit-flipped newest commit is skipped to
+    the previous valid version — no crash, logged + counted, nothing
+    renamed (read-only consumer)."""
+    import os
+    import shutil
+
+    root, *_ = versions
+    work = str(tmp_path / "root")
+    shutil.copytree(root, work)
+    plan = FaultPlan(seed=7)
+    plan.bit_flip(os.path.join(work, "ckpt-00000002", "arrays.msgpack"))
+
+    reg = MetricsRegistry()
+    with pytest.warns(UserWarning, match="torn/corrupt"):
+        found = newest_valid_version(work, registry=reg)
+    assert found is not None and found[0] == 1
+    assert reg.snapshot()["serve_swap_versions_skipped_total"] == 1
+    # the corrupt dir is still there under its own name — no quarantine
+    assert sorted(list_steps(work)) == [1, 2]
+
+    # the factory refuses to load the corrupt version explicitly...
+    factory = EngineFactory(work, max_batch=4, registry=reg)
+    with pytest.raises(Exception, match="checksum|missing"):
+        factory(2)
+    # ...and newest() already steered to the valid one (same skip warning)
+    with pytest.warns(UserWarning, match="torn/corrupt"):
+        assert factory.newest() == 1
+    eng = factory(1)
+    assert eng.version == 1
+
+
+def test_factory_engine_bit_identical_to_fresh(versions):
+    """ACCEPTANCE (satellite): the factory-loaded newest commit is
+    bit-identical to a freshly constructed engine at EVERY serve
+    bucket."""
+    root, model, (_, params2), state, pool = versions
+    factory = EngineFactory(root, max_batch=4)
+    eng = factory(factory.newest())
+    assert eng.version == 2 and eng.bucket_sizes == [1, 2, 4]
+    fresh = InferenceEngine.from_model(model, params2, state, max_batch=4)
+    for b in fresh.bucket_sizes:
+        np.testing.assert_array_equal(
+            np.asarray(eng.infer(pool[:b])),
+            np.asarray(fresh.infer(pool[:b])))
+
+
+def test_replica_hot_swap_bit_identity_every_bucket(versions):
+    """ACCEPTANCE (satellite): drain → load newest → rejoin through a
+    LocalReplica serves results bit-identical to a fresh engine of the
+    new version at every bucket; the old version's results differ
+    (the swap really happened)."""
+    root, model, (params1, params2), state, pool = versions
+    factory = EngineFactory(root, max_batch=4)
+    rep = LocalReplica(factory, 1, name="swapper", queue_capacity=32,
+                       start=False)
+    try:
+        fresh1 = InferenceEngine.from_model(model, params1, state,
+                                            max_batch=4)
+        f = rep.submit(pool[:2])
+        rep.step()
+        np.testing.assert_array_equal(np.asarray(f.result(timeout=0)),
+                                      np.asarray(fresh1.infer(pool[:2])))
+
+        rep.swap(2)  # drain -> load ckpt-2 -> rejoin
+        assert rep.version == 2
+
+        fresh2 = InferenceEngine.from_model(model, params2, state,
+                                            max_batch=4)
+        for b in fresh2.bucket_sizes:
+            f = rep.submit(pool[:b] if b > 1 else pool[0])
+            rep.step()
+            got = np.asarray(f.result(timeout=0))
+            want = np.asarray(fresh2.infer(pool[:b] if b > 1 else pool[0]))
+            np.testing.assert_array_equal(got, want)
+        # and it is genuinely the NEW version: v1 answers differ
+        assert not np.array_equal(np.asarray(fresh1.infer(pool[:2])),
+                                  np.asarray(fresh2.infer(pool[:2])))
+    finally:
+        rep.close()
+
+
+def test_swap_fault_mid_load_rejoins_old_version(versions):
+    root, model, (params1, _), state, pool = versions
+    factory = EngineFactory(root, max_batch=4)
+    plan = FaultPlan().arm("serve.swap", exc=InjectedFault, times=1)
+    rep = LocalReplica(factory, 1, name="sticky", queue_capacity=32,
+                       fault_plan=plan, start=False)
+    try:
+        with pytest.raises(SwapError, match="rejoined on old version"):
+            rep.swap(2)
+        assert rep.version == 1 and rep.health() is None
+        fresh1 = InferenceEngine.from_model(model, params1, state,
+                                            max_batch=4)
+        f = rep.submit(pool[:2])
+        rep.step()
+        np.testing.assert_array_equal(np.asarray(f.result(timeout=0)),
+                                      np.asarray(fresh1.infer(pool[:2])))
+        rep.swap(2)  # plan disarmed (times=1): now it succeeds
+        assert rep.version == 2
+    finally:
+        rep.close()
+
+
+def test_factory_missing_version_raises(versions):
+    root, *_ = versions
+    factory = EngineFactory(root, max_batch=4)
+    with pytest.raises(Exception, match="missing|checksum"):
+        factory(99)
