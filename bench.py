@@ -14,10 +14,13 @@ numbers (BASELINE.md).
 Extra reported fields: achieved model TFLOP/s and MFU (from the model's own
 analytic FLOP count — forward_complexity x3 for fwd+bwd, the standard
 training-FLOPs convention), per-step latency, and with BENCH_MATRIX=1 a
-layout x dtype sweep (NCHW/NHWC x fp32/bf16). Since r6 the capture also
-carries `mfu_analytic` (XLA cost_analysis FLOPs of the actual compiled
-step executable — reported NEXT TO the formula value `mfu_formula` for one
-release; `mfu` stays the formula figure the r01-r05 trajectory gates on),
+layout x dtype sweep (NCHW/NHWC x fp32/bf16). Since r6 the capture carries
+both MFU figures, and as of this release the headline `mfu` IS
+`mfu_analytic` (XLA cost_analysis FLOPs of the actual compiled step
+executable — what the program really costs post-fusion) with
+`mfu_formula` (forward_complexity x3) kept as the secondary key the
+r01-r05 trajectory gated on (obs/regress.py gates both, with an `mfu`
+fallback for pre-switch captures), plus
 `roofline_bytes_per_flop` + `phases.xla_cost` (the executable's
 bytes-accessed/FLOP roofline coordinate), a `telemetry_essentials` block
 (compile_total/compile_seconds_total counters, HBM watermark, h2d gauges —
@@ -78,7 +81,12 @@ BENCH_FAULTS_REPS — emitted under a "resilience" key: sync save wall,
 async save's step-loop cost, verified-restore wall, plus an "elastic"
 sub-block measuring a real kill-a-host recovery on a 2-peer loopback DP
 fleet: detection latency, checkpoint-restore wall, reconfiguration wall,
-optimizer steps lost; docs/reliability.md §"Elastic training").
+optimizer steps lost; docs/reliability.md §"Elastic training"),
+BENCH_AOT=1 for the AOT executable-cache probe (dcnn_tpu/aot/ — emitted
+under an "aot" key: cold-start-to-first-step on a warm cache for the
+headline train step and a serve bucket set, `phases.aot_warm_start_s`
+regression-gated; knob BENCH_AOT_SERVE_MAX_BATCH default 16; the cache
+root is the shared compile-cache root, AOT_CACHE/DCNN_COMPILE_CACHE).
 """
 
 from __future__ import annotations
@@ -210,11 +218,14 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
 
     def _cache_entries():
         # persistent compile-cache population (utils.enable_compile_cache
-        # pointed jax at a dir); None when the cache isn't file-backed
+        # pointed jax at a dir); None when the cache isn't file-backed.
+        # Files only: the AOT executable store lives in an `aot/` subdir
+        # of the same root and its commits must not perturb this count
         d = getattr(jax.config, "jax_compilation_cache_dir", None)
         if not d or not os.path.isdir(d):
             return None
-        return len(os.listdir(d))
+        return sum(1 for n in os.listdir(d)
+                   if os.path.isfile(os.path.join(d, n)))
 
     n_cache0 = _cache_entries()
     t0 = time.perf_counter()
@@ -1037,6 +1048,141 @@ def elastic_subsection():
     }
 
 
+def aot_section(data_format, batch, chunk):
+    """BENCH_AOT=1: the AOT executable cache's operational headline —
+    **cold-start-to-first-step on a warm cache** (ROADMAP item 4 targets
+    <10 s against the 149.9 s r05 compile wall), for both the headline
+    train step and a serve engine's bucket set.
+
+    Method: a FRESH ``jax.jit`` of the headline computation goes through
+    ``aot.warm_or_compile``. The first pass may hit (a prior bench run or
+    prewarm seeded the shared cache — that IS the cross-run measurement)
+    or miss (this run pays the one cold compile and commits it); either
+    way a second fresh jit must hit, and its wall — key derivation +
+    deserialize + one fenced step — is ``aot_warm_start_s``. The serve
+    half builds the same engine twice (``aot_cache`` on): the second
+    construction's per-bucket sessions all deserialize. Knob:
+    ``BENCH_AOT_SERVE_MAX_BATCH`` (default 16)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dcnn_tpu.aot import ExecutableCache, aot_dir, digest, warm_or_compile
+    from dcnn_tpu.aot.keys import train_step_key_material
+    from dcnn_tpu.core.fence import hard_fence
+    from dcnn_tpu.models import (
+        create_resnet18_tiny_imagenet, create_resnet50_tiny_imagenet)
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.ops.losses import softmax_cross_entropy
+    from dcnn_tpu.train import make_multi_step, make_train_step
+    from dcnn_tpu.train.trainer import create_train_state
+    from dcnn_tpu.utils.compile_cache import resolve_cache_root
+
+    # an untrusted default root (another user's /tmp/jax_cache on a
+    # shared host) must skip the section, not discard the whole capture
+    # after minutes of measurement — every library call site degrades
+    # the same way
+    try:
+        cache = ExecutableCache(aot_dir(resolve_cache_root()))
+    except (ValueError, OSError) as e:
+        return {"skipped": f"{type(e).__name__}: {e}"}
+    bench_model = os.environ.get("BENCH_MODEL", "resnet18")
+    make = {"resnet18": create_resnet18_tiny_imagenet,
+            "resnet50": create_resnet50_tiny_imagenet}[bench_model]
+    model = make(data_format)
+    opt = Adam(1e-3)
+    key = jax.random.PRNGKey(0)
+    shape = ((batch, 3, 64, 64) if data_format == "NCHW"
+             else (batch, 64, 64, 3))
+    rng0 = np.random.default_rng(0)
+    if chunk > 1:
+        x = jnp.asarray(rng0.normal(size=(chunk,) + shape).astype(np.float32))
+        y = jnp.asarray(np.eye(200, dtype=np.float32)[
+            rng0.integers(0, 200, size=(chunk, batch))])
+        kind = "multi_step"
+    else:
+        x = jnp.asarray(rng0.normal(size=shape).astype(np.float32))
+        y = jnp.asarray(np.eye(200, dtype=np.float32)[
+            rng0.integers(0, 200, size=batch)])
+        kind = "train_step"
+    # the SAME helper Trainer._wire_aot keys with — so this phase
+    # measures the entry a real trainer process would actually hit
+    config = digest(train_step_key_material(
+        model, opt, softmax_cross_entropy, kind=kind))
+
+    def start_to_first_step():
+        # everything a restarted process pays between "jit exists" and
+        # "first optimizer step done": state init + executable
+        # acquisition + one fenced step
+        t0 = time.perf_counter()
+        ts = create_train_state(model, opt, key)
+        if chunk > 1:
+            step = make_multi_step(model, softmax_cross_entropy, opt)
+        else:
+            step = make_train_step(model, softmax_cross_entropy, opt)
+        exe, info = warm_or_compile(step, ts, x, y,
+                                    jax.random.fold_in(key, 997), 1e-3,
+                                    cache=cache, what="train",
+                                    config=config, donate=(0,))
+        out = exe(ts, x, y, jax.random.fold_in(key, 997), 1e-3)
+        hard_fence(out[1])
+        return time.perf_counter() - t0, info
+
+    wall1, info1 = start_to_first_step()
+    if info1["hit"]:
+        cold_s, warm_s, warm_info = None, wall1, info1
+    else:
+        cold_s = wall1
+        warm_s, warm_info = start_to_first_step()
+    x = y = None
+    train_block = {
+        "aot_cold_start_s": round(cold_s, 3) if cold_s is not None else None,
+        "aot_warm_start_s": round(warm_s, 3),
+        "first_pass_hit": info1["hit"],
+        "warm_hit": warm_info["hit"],
+        "deserialize_s": warm_info.get("deserialize_s"),
+        "compile_s": info1.get("compile_s"),
+        "warm_vs_cold": (round(warm_s / cold_s, 4)
+                         if cold_s else None),
+    }
+
+    # serve bucket set: the replica spin-up / hot-swap wall
+    from dcnn_tpu.serve.engine import InferenceEngine
+    serve_mb = int(os.environ.get("BENCH_AOT_SERVE_MAX_BATCH", "16"))
+    ts = create_train_state(model, opt, key)
+
+    def spinup():
+        t0 = time.perf_counter()
+        eng = InferenceEngine.from_model(
+            model, ts.params, ts.state, fold=True, max_batch=serve_mb,
+            warmup=False, aot_cache=cache, name=f"aot_{bench_model}")
+        return time.perf_counter() - t0, eng
+
+    wall_a, eng_a = spinup()
+    hits_a = sum(1 for s in eng_a.compile_stats.values() if s.get("aot_hit"))
+    eng_a = None
+    wall_b, eng_b = spinup()
+    hits_b = sum(1 for s in eng_b.compile_stats.values() if s.get("aot_hit"))
+    buckets = list(eng_b.bucket_sizes)
+    eng_b = None
+    serve_block = {
+        "max_batch": serve_mb,
+        "buckets": buckets,
+        "cold_spinup_s": (None if hits_a == len(buckets)
+                          else round(wall_a, 3)),
+        "warm_spinup_s": round(wall_b, 3),
+        "warm_hits": hits_b,
+        "warm_vs_cold": (round(wall_b / wall_a, 4)
+                         if hits_a < len(buckets) else None),
+    }
+    return {
+        "cache_dir": cache.root,
+        "entries": len(cache.entries()),
+        "train": train_block,
+        "serve": serve_block,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1087,16 +1233,20 @@ def main() -> None:
     device_kind = jax.devices()[0].device_kind
     peak = _peak_tflops(device_kind)
     precision = os.environ.get("DCNN_PRECISION", "bf16").lower()
-    mfu = (round(tflops / peak, 4)
-           if peak and precision in ("fast", "bf16") else None)
-    # cost-analysis-derived MFU reported NEXT TO the forward_complexity()x3
-    # formula value for one release (mfu itself stays the formula figure
-    # the r01-r05 trajectory and its regression gate were built on; it
-    # switches to the analytic value once r06+ captures carry both)
+    mfu_formula = (round(tflops / peak, 4)
+                   if peak and precision in ("fast", "bf16") else None)
+    # headline `mfu` is now the XLA cost-analysis figure (the switch PR 6
+    # deferred "next release"): what the compiled program actually costs,
+    # post-fusion, instead of the model's forward_complexity()x3 estimate.
+    # `mfu_formula` stays as the secondary key — it is the series the
+    # r01-r05 trajectory gated on, and obs/regress.py gates it (with an
+    # `mfu` fallback for pre-switch captures) alongside `mfu_analytic`.
     from dcnn_tpu.obs.xla import analytic_mfu
     xc = phases.get("xla_cost") or {}
     mfu_analytic = (analytic_mfu(xc.get("flops_per_img"), img_per_sec, peak)
                     if peak and precision in ("fast", "bf16") else None)
+    mfu = (round(mfu_analytic, 4) if mfu_analytic is not None
+           else mfu_formula)
 
     baseline_kind, baseline = _load_measured_baseline(root)
     if baseline is not None:
@@ -1119,7 +1269,7 @@ def main() -> None:
         "sec_per_step": round(sec_per_step, 4),
         "model_tflops_per_sec": round(tflops, 2),
         "mfu": mfu,
-        "mfu_formula": mfu,
+        "mfu_formula": mfu_formula,
         "mfu_analytic": (round(mfu_analytic, 4)
                          if mfu_analytic is not None else None),
         "roofline_bytes_per_flop": xc.get("bytes_per_flop"),
@@ -1177,6 +1327,15 @@ def main() -> None:
     if os.environ.get("BENCH_FAULTS", "0") == "1":
         out["resilience"] = faults_section()
 
+    # AOT executable cache: cold-start-to-first-step on a warm cache
+    # (opt-in — a cold cache pays one extra headline compile to seed it;
+    # warm runs cost seconds)
+    if os.environ.get("BENCH_AOT", "0") == "1":
+        out["aot"] = aot_section(data_format, batch, chunk)
+        if "train" in out["aot"]:
+            out["phases"]["aot_warm_start_s"] = \
+                out["aot"]["train"]["aot_warm_start_s"]
+
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision
         # the main run already measured the (data_format, precision) cell
@@ -1209,6 +1368,10 @@ def main() -> None:
         "compile_total": snap.get("compile_total", 0),
         "compile_seconds_total": round(
             float(snap.get("compile_seconds_total", 0.0)), 3),
+        "compile_cache_hit": out["phases"].get("compile_cache_hit"),
+        "aot_warm_start_s": out["phases"].get("aot_warm_start_s"),
+        "aot_hits_total": snap.get("aot_hits_total"),
+        "aot_misses_total": snap.get("aot_misses_total"),
         "hbm_peak_bytes": hbm.get("hbm_peak_bytes"),
         "hbm_bytes_in_use": hbm.get("hbm_bytes_in_use"),
         "hbm_bytes_limit": hbm.get("hbm_bytes_limit"),

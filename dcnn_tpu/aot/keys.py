@@ -1,0 +1,205 @@
+"""Cache-key derivation for persisted XLA executables.
+
+A cached executable is only reusable when *everything* that shaped its
+compilation matches: the compiler (jax/jaxlib carry the XLA revision), the
+hardware it was compiled for, the input avals (shapes/dtypes/weak-types),
+the donation signature, the precision mode, and — because jit closes over
+model structure, optimizer hyperparameters, and (for serving graphs) the
+weights themselves as constants — a digest of that closed-over
+configuration. The key is a SHA-256 over the canonical JSON of all of
+those components, so *derivation never traces or lowers anything*: a
+cache hit goes from process start to a loaded executable without paying
+the trace wall, which is the whole point (ROADMAP item 4 targets
+cold-start-to-first-step <10 s against a 149.9 s compile).
+
+The flip side of a no-trace key is that the ``config`` digest is a
+*contract*: a call site must fold in every value its jitted function
+closes over that can change the compiled program (the in-repo call sites
+— ``Trainer``, ``serve/engine``, ``parallel/elastic``,
+``parallel/compiled_pipeline`` — each document what they fold in).
+Under-keying serves a stale executable silently; when in doubt, fold it
+in — an extra miss costs one compile, a collision costs correctness.
+
+jax is imported lazily (this package must be importable before backend
+selection, same promise as ``dcnn_tpu.obs``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# bump when the key layout itself changes: old entries become misses, not
+# deserialization errors
+KEY_SCHEMA = 1
+
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The compiler + hardware identity an executable is only valid for:
+    jax/jaxlib versions (they pin the XLA revision), backend platform,
+    device kind, and the device/process topology counts."""
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devs[0].device_kind if devs else "none",
+        "device_count": len(devs),
+        "process_count": jax.process_count(),
+    }
+
+
+def aval_signature(args: Sequence[Any]) -> Dict[str, Any]:
+    """Structure + per-leaf ``(shape, dtype, weak_type)`` of a call's
+    arguments — concrete arrays and ``jax.ShapeDtypeStruct`` specs
+    describe the same executable, so both abstract to the same signature.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tuple(args))
+    sig = []
+    for leaf in leaves:
+        a = jax.api_util.shaped_abstractify(leaf)
+        sig.append([list(a.shape), str(a.dtype),
+                    bool(getattr(a, "weak_type", False))])
+    return {"treedef": str(treedef), "leaves": sig}
+
+
+def _precision_mode() -> str:
+    try:
+        from ..core.precision import get_precision_mode
+        return get_precision_mode()
+    except Exception:
+        return "unknown"
+
+
+def callable_id(fn: Any) -> str:
+    """Process-stable identity for a closed-over callable:
+    ``module.qualname`` (plus frozen args for ``functools.partial``) —
+    never ``repr``, whose ``0x…`` address would change the key every
+    process and turn the cache into a miss machine.
+
+    A *bound method* additionally folds in its instance's
+    ``get_config()`` digest when it has one: ``stack.stage_fn`` has the
+    same qualname for every ``SequentialStageStack``, but two stacks
+    built from different blocks compile to different programs — the
+    qualname alone would collide and silently serve the wrong
+    architecture."""
+    import functools
+
+    if isinstance(fn, functools.partial):
+        inner = callable_id(fn.func)
+        return (f"partial({inner}, args={fn.args!r}, "
+                f"kw={sorted((fn.keywords or {}).items())!r})")
+    mod = getattr(fn, "__module__", None) or type(fn).__module__
+    qn = (getattr(fn, "__qualname__", None)
+          or type(fn).__qualname__)
+    base = f"{mod}.{qn}"
+    owner = getattr(fn, "__self__", None)
+    if owner is not None and not isinstance(owner, type):
+        get_config = getattr(owner, "get_config", None)
+        if callable(get_config):
+            try:
+                return f"{base}<{digest(get_config())}>"
+            except Exception:
+                pass
+    return base
+
+
+def optimizer_id(optimizer: Any) -> Any:
+    """Stable key material for an optimizer: its config dict **minus
+    ``learning_rate``** (lr rides into every jitted step as a runtime
+    argument, so it never shapes the compiled program — keeping it in
+    the key would miss across lr variants and silently defeat prewarm),
+    falling back to the type identity when there is no config."""
+    try:
+        cfg = dict(optimizer.get_config())
+        cfg.pop("learning_rate", None)
+        return cfg
+    except Exception:
+        t = type(optimizer)
+        return f"{t.__module__}.{t.__qualname__}"
+
+
+def train_step_key_material(model: Any, optimizer: Any, loss_fn: Any, *,
+                            num_microbatches: int = 1, guard: bool = False,
+                            kind: str = "train_step") -> Dict[str, Any]:
+    """The one canonical key-material dict for a ``Trainer``-shaped train
+    step — everything ``make_train_step``/``make_multi_step`` close over
+    that shapes the compiled program. ``Trainer._wire_aot``, the bench
+    ``aot`` phase, and the CLI ``--prewarm`` all call this, so a prewarmed
+    entry is guaranteed to hit for the real trainer (three hand-rolled
+    copies of this dict would silently desynchronize)."""
+    return {
+        "model": model.get_config(),
+        "optimizer": optimizer_id(optimizer),
+        "loss": callable_id(loss_fn),
+        "num_microbatches": int(num_microbatches),
+        "guard": bool(guard),
+        "kind": kind,
+    }
+
+
+def digest(obj: Any) -> str:
+    """Stable SHA-256 of any JSON-able structure (non-JSON leaves fall
+    back to ``repr``, which is stable for the repo's config objects)."""
+    blob = json.dumps(obj, sort_keys=True, default=repr,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def digest_arrays(tree: Any) -> str:
+    """SHA-256 over every leaf's bytes + shape/dtype in tree-flatten
+    order — the weights digest serving graphs need (jit bakes closed-over
+    arrays into the program as constants, so two checkpoints of the same
+    architecture compile to *different* executables)."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.ascontiguousarray(jax.device_get(leaf))
+        h.update(str((a.shape, str(a.dtype))).encode("utf-8"))
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def cache_key(args: Sequence[Any], *,
+              config: Optional[Any] = None,
+              donate: Tuple[int, ...] = (),
+              extra: Optional[Dict[str, Any]] = None,
+              fingerprint: Optional[Dict[str, Any]] = None
+              ) -> Tuple[str, Dict[str, Any]]:
+    """Derive ``(key_hex, material)`` for one executable. ``material`` is
+    the pre-hash component dict — it lands in the entry MANIFEST so a
+    human (or the CLI) can see *why* two keys differ."""
+    material = {
+        "schema": KEY_SCHEMA,
+        "fingerprint": fingerprint if fingerprint is not None
+        else backend_fingerprint(),
+        "avals": aval_signature(args),
+        "donate": sorted(int(i) for i in donate),
+        "precision": _precision_mode(),
+        "config": config if isinstance(config, str) else digest(config),
+        "extra": extra or {},
+    }
+    return digest(material), material
+
+
+def short_avals(material: Dict[str, Any], limit: int = 4) -> str:
+    """Compact human-readable aval summary for listings:
+    ``f32[8,64,64,3], f32[8,200], …(+7)``."""
+    leaves = material.get("avals", {}).get("leaves", [])
+    parts = []
+    for shape, dtype, _weak in leaves[:limit]:
+        dt = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+              "int32": "i32", "int64": "i64", "uint32": "u32",
+              "uint8": "u8", "int8": "i8", "bool": "pred"}.get(dtype, dtype)
+        parts.append(f"{dt}[{','.join(str(d) for d in shape)}]")
+    if len(leaves) > limit:
+        parts.append(f"…(+{len(leaves) - limit})")
+    return ", ".join(parts)

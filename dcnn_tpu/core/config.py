@@ -101,6 +101,15 @@ class TrainingConfig:
                                       # (WorldCollapsedError) instead of
                                       # limping on
 
+    # -- AOT executable cache (dcnn_tpu/aot; docs/performance.md) --
+    aot_cache_dir: Optional[str] = None  # cache ROOT: warm-start the
+                                      # train/multi step from persisted
+                                      # executables under <root>/aot and
+                                      # commit fresh compiles there
+                                      # (shareable across processes and
+                                      # hosts). None: AOT_CACHE env, else
+                                      # off.
+
     # -- external telemetry (dcnn_tpu/obs/server.py; docs/observability.md)
     metrics_port: int = -1            # >=0: serve /metrics + /healthz +
                                       # /snapshot over HTTP for the whole
@@ -151,6 +160,8 @@ class TrainingConfig:
                                        base.elastic_ckpt_steps),
             elastic_min_world=get_env("ELASTIC_MIN_WORLD",
                                       base.elastic_min_world),
+            aot_cache_dir=get_env("AOT_CACHE",
+                                  base.aot_cache_dir or "") or None,
             metrics_port=get_env("METRICS_PORT", base.metrics_port),
         )
 

@@ -50,13 +50,18 @@ _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
 @dataclass(frozen=True)
 class MetricSpec:
     """One gated metric: a dotted ``path`` into the capture's parsed JSON,
-    a direction, and an optional noise tolerance / comparability guard."""
+    a direction, and an optional noise tolerance / comparability guard.
+    ``fallback`` names a second path tried when ``path`` is absent — the
+    continuity mechanism for renamed keys (``mfu_formula`` reads old
+    captures' ``mfu``, so the r01-r05 trajectory keeps gating the formula
+    series across the headline-MFU switch)."""
 
     name: str
     path: str
     higher_is_better: bool = True
     tolerance: Optional[float] = None  # None -> the gate's default
     guard: Optional[str] = None        # dotted path; must match to compare
+    fallback: Optional[str] = None     # alternate path for older captures
 
 
 # The ISSUE-mandated gate set: img/s, MFU, h2d bandwidth, compile wall,
@@ -66,10 +71,27 @@ class MetricSpec:
 # number, so its tolerance is tight.
 DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("img_per_sec", "value"),
-    MetricSpec("mfu", "mfu"),
+    # headline-MFU switch (this release): `mfu` is now the XLA
+    # cost-analysis figure, so the continuous formula series moved to
+    # `mfu_formula` — gated with an `mfu` fallback so r01-r05 captures
+    # (which only carry `mfu` = the formula value) stay in the window;
+    # the analytic series gates separately and only against captures
+    # that measured it.
+    MetricSpec("mfu_formula", "mfu_formula", fallback="mfu"),
+    MetricSpec("mfu_analytic", "mfu_analytic"),
     MetricSpec("h2d_gbps", "h2d_gbps", tolerance=0.75),
     MetricSpec("compile_s", "phases.compile_s", higher_is_better=False,
                tolerance=0.5, guard="phases.compile_cache_hit"),
+    # the AOT warm-start wall (BENCH_AOT=1): guarded on the capture's
+    # warm_hit flag — on the serialization-fallback path (backend that
+    # can't serialize, full disk) NOTHING is committed, so the "warm"
+    # pass is a full compile wall; comparing that against hit-path
+    # captures would flag a spurious 150 s "regression" (or poison the
+    # window and mask a real one). 50% tolerance absorbs deserialize/IO
+    # jitter on small absolute values
+    MetricSpec("aot_warm_start_s", "phases.aot_warm_start_s",
+               higher_is_better=False, tolerance=0.5,
+               guard="aot.train.warm_hit"),
     MetricSpec("serve_int8_img_per_sec", "infer_int8_img_per_sec"),
     MetricSpec("serve_router_capacity_img_per_sec",
                "serving.router.capacity_img_per_sec",
@@ -138,9 +160,16 @@ def compare(history: Sequence[Dict[str, Any]], *,
     newest, prior = history[-1], list(history[:-1])
     rows: List[Dict[str, Any]] = []
     regressions: List[str] = []
+
+    def resolve(entry, spec):
+        v = get_path(entry, spec.path)
+        if v is None and spec.fallback:
+            v = get_path(entry, spec.fallback)
+        return v
+
     for spec in metrics:
         tol = spec.tolerance if spec.tolerance is not None else tolerance
-        cur = get_path(newest, spec.path)
+        cur = resolve(newest, spec)
         row: Dict[str, Any] = {
             "metric": spec.name, "path": spec.path,
             "higher_is_better": spec.higher_is_better,
@@ -153,7 +182,7 @@ def compare(history: Sequence[Dict[str, Any]], *,
         guard_val = get_path(newest, spec.guard) if spec.guard else None
         vals: List[float] = []
         for entry in reversed(prior):  # newest-first until the window fills
-            v = get_path(entry, spec.path)
+            v = resolve(entry, spec)
             if not isinstance(v, (int, float)):
                 continue
             if spec.guard and get_path(entry, spec.guard) != guard_val:

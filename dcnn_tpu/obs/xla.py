@@ -112,6 +112,37 @@ def record_compile(seconds: float, *, what: str = "",
             max(seconds, 0.0))
 
 
+def record_aot(event: str, seconds: float = 0.0, *,
+               registry: Optional[MetricsRegistry] = None) -> None:
+    """Account one AOT executable-cache event (``dcnn_tpu/aot``):
+    ``hit`` (+ deserialize seconds), ``miss``, ``commit``,
+    ``quarantined`` (corrupt entry set aside), ``stale`` (version
+    mismatch skipped), ``fallback`` (backend can't serialize). The
+    hit/miss ratio against :func:`record_compile`'s
+    ``compile_seconds_total`` is THE judgment series for the compile-wall
+    work (ROADMAP item 4)."""
+    reg = registry if registry is not None else get_registry()
+    names = {
+        "hit": ("aot_hits_total", "AOT executable cache hits"),
+        "miss": ("aot_misses_total", "AOT executable cache misses"),
+        "commit": ("aot_commits_total", "AOT executables committed"),
+        "quarantined": ("aot_quarantined_total",
+                        "corrupt AOT entries quarantined"),
+        "stale": ("aot_stale_total",
+                  "stale-version AOT entries skipped"),
+        "fallback": ("aot_fallback_total",
+                     "AOT serialize/deserialize fallbacks to plain "
+                     "compilation"),
+    }
+    name, help_ = names.get(event, (f"aot_{event}_total",
+                                    f"AOT cache {event} events"))
+    reg.counter(name, help_).inc()
+    if event == "hit" and seconds > 0:
+        reg.counter("aot_deserialize_seconds_total",
+                    "wall seconds deserializing cached AOT "
+                    "executables").inc(seconds)
+
+
 def analytic_mfu(flops_per_sample: Optional[float],
                  samples_per_sec: Optional[float],
                  peak_tflops: Optional[float]) -> Optional[float]:

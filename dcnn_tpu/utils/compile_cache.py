@@ -4,6 +4,19 @@ One canonical helper instead of per-entry-point copies (tests/conftest.py,
 bench.py, benchmarks/common.py): multi-stage scans and big train steps cost
 minutes to compile on a 1-core host, so every harness wants cache hits on
 rerun — and the thresholds must not drift between call sites.
+
+Cache-root resolution (one knob, documented precedence, shared with the
+AOT executable cache — ``dcnn_tpu/aot``):
+
+1. ``AOT_CACHE`` env — the subsystem-era knob; setting it both places
+   the XLA text cache *and* enables the executable cache;
+2. ``DCNN_COMPILE_CACHE`` env — the legacy knob (XLA text cache only;
+   it does NOT enable the AOT subsystem);
+3. the ``cache_dir`` argument (default ``/tmp/jax_cache``).
+
+Layout under the resolved root: jax's persistent-compile-cache files live
+directly in the root (unchanged from every earlier release, so existing
+warm caches keep hitting), serialized executables under ``<root>/aot``.
 """
 
 from __future__ import annotations
@@ -11,13 +24,25 @@ from __future__ import annotations
 import os
 
 
+def resolve_cache_root(cache_dir: str = "/tmp/jax_cache") -> str:
+    """The one cache-root resolution every entry point shares
+    (precedence in the module docstring)."""
+    return (os.environ.get("AOT_CACHE", "").strip()
+            or os.environ.get("DCNN_COMPILE_CACHE", "").strip()
+            or cache_dir)
+
+
 def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
-                         min_compile_secs: float = 0.5) -> None:
-    """Idempotent: safe to call from any entry point, any number of times."""
+                         min_compile_secs: float = 0.5) -> str:
+    """Point jax's persistent compilation cache at the resolved root and
+    return that root (``dcnn_tpu.aot`` keys its executable store off the
+    same resolution — one dir to ship between hosts). Idempotent: safe to
+    call from any entry point, any number of times."""
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("DCNN_COMPILE_CACHE", cache_dir))
+    root = resolve_cache_root(cache_dir)
+    jax.config.update("jax_compilation_cache_dir", root)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return root

@@ -89,7 +89,10 @@ def test_missing_metric_and_empty_window_skip():
     report = regress.compare([{"value": 10.0}, {"mfu": 0.4}])
     rows = {r["metric"]: r["verdict"] for r in report["metrics"]}
     assert rows["img_per_sec"].startswith("skipped")  # absent from newest
-    assert rows["mfu"].startswith("skipped")          # no prior capture
+    # mfu_formula reads the legacy `mfu` key via its fallback, but the
+    # prior capture carries neither -> still no comparable window
+    assert rows["mfu_formula"].startswith("skipped")
+    assert rows["mfu_analytic"].startswith("skipped")
     assert report["ok"]
 
 

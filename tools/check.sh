@@ -10,7 +10,9 @@
 #      covered by stage 2's parse pass.
 #   2. python -m dcnn_tpu.analysis dcnn_tpu/ — the trace-safety /
 #      concurrency / atomicity suite against the committed baseline
-#      (docs/static_analysis.md). Zero unsuppressed findings required.
+#      (docs/static_analysis.md). Zero unsuppressed findings required;
+#      this covers dcnn_tpu/aot/ too (CC03 resource-lifecycle applies to
+#      its cross-process file locks — zero baseline entries).
 #   3. benchmarks/compare.py --self-test — the bench regression gate's own
 #      fixture run (planted 25% drop must flag; clean history must pass).
 #
