@@ -86,7 +86,14 @@ BENCH_AOT=1 for the AOT executable-cache probe (dcnn_tpu/aot/ — emitted
 under an "aot" key: cold-start-to-first-step on a warm cache for the
 headline train step and a serve bucket set, `phases.aot_warm_start_s`
 regression-gated; knob BENCH_AOT_SERVE_MAX_BATCH default 16; the cache
-root is the shared compile-cache root, AOT_CACHE/DCNN_COMPILE_CACHE).
+root is the shared compile-cache root, AOT_CACHE/DCNN_COMPILE_CACHE),
+BENCH_AUTOSCALE=1 for the telemetry-driven autoscaler's diurnal soak
+(dcnn_tpu/serve/soak.py, the same sleep-free driver tier-1 gates —
+emitted under an "autoscale" key: availability / slo_violation_minutes /
+scale_up_reaction_s regression-gated via autoscale.* in
+dcnn_tpu/obs/regress.py; knobs BENCH_AUTOSCALE_SECONDS default 240,
+BENCH_AUTOSCALE_PEAK_RPS/_TROUGH_RPS default 200/20;
+docs/deployment.md §6).
 """
 
 from __future__ import annotations
@@ -911,6 +918,70 @@ def router_section(data_format, engines=None, seconds=None,
     }
 
 
+def autoscale_section():
+    """BENCH_AUTOSCALE=1 ``autoscale`` block: the telemetry-driven
+    autoscaler's diurnal soak (dcnn_tpu/serve/soak.py — the same driver
+    tier-1 gates, so the capture's numbers and the test's assertions can
+    never drift apart). A 10x peak-to-trough diurnal curve through the
+    router with a replica preemption and a canary swap injected
+    mid-load, the autoscaler breathing the fleet between 1 and 6
+    replicas; entirely virtual-time (fake clock, zero sleeps), so a
+    four-minute soak costs well under a second of wall.
+
+    Regression-gated keys (obs/regress.py ``autoscale.*``):
+    ``availability`` (completed/accepted through kill + canary + every
+    resize), ``slo_violation_minutes`` (integrated breach time), and
+    ``scale_up_reaction_s`` (worst breach-start → capacity-added wall,
+    gated only against captures with the same ``up_cooldown_s`` budget).
+    Knobs: BENCH_AUTOSCALE_SECONDS (virtual soak length = diurnal
+    period, default 240), BENCH_AUTOSCALE_PEAK_RPS / _TROUGH_RPS
+    (default 200 / 20)."""
+    from dcnn_tpu.serve.soak import run_diurnal_soak
+
+    seconds = float(os.environ.get("BENCH_AUTOSCALE_SECONDS", "240"))
+    peak = float(os.environ.get("BENCH_AUTOSCALE_PEAK_RPS", "200"))
+    trough = float(os.environ.get("BENCH_AUTOSCALE_TROUGH_RPS", "20"))
+    t0 = time.perf_counter()
+    report, scaler, router = run_diurnal_soak(
+        seconds=seconds, period=seconds, peak=peak, trough=trough)
+    wall = time.perf_counter() - t0
+    try:
+        cfg = scaler.cfg
+        reaction = report["reaction_max_s"]
+        return {
+            "soak_virtual_seconds": seconds,
+            "wall_seconds": round(wall, 3),
+            "peak_rps": peak,
+            "trough_rps": trough,
+            "peak_to_trough_x": round(peak / trough, 2),
+            "availability": (round(report["availability"], 6)
+                             if report["availability"] is not None
+                             else None),
+            "slo_violation_minutes": round(
+                report["slo_violation_minutes"], 4),
+            "scale_up_reaction_s": (round(reaction, 3)
+                                    if reaction is not None else None),
+            "accepted": report["accepted"],
+            "completed": report["completed"],
+            "typed_failures": report["typed_failures"],
+            "silently_dropped": report["silently_dropped"],
+            "scale_ups": report["scale_ups"],
+            "scale_downs": report["scale_downs"],
+            "peak_fleet": report["peak_fleet"],
+            "final_fleet": report["final_fleet"],
+            "up_cooldown_s": cfg.up_cooldown_s,
+            "down_cooldown_s": cfg.down_cooldown_s,
+            "slo_p99_ms": cfg.slo_p99_ms,
+        }
+    finally:
+        router.shutdown(drain=False)
+        for r in router.replicas().values():
+            try:
+                r.close()
+            except Exception:
+                pass
+
+
 def faults_section():
     """BENCH_FAULTS=1: the measured cost of robustness — checkpoint
     save/restore wall for a real model's train state, sync vs async (the
@@ -1335,6 +1406,11 @@ def main() -> None:
         if "train" in out["aot"]:
             out["phases"]["aot_warm_start_s"] = \
                 out["aot"]["train"]["aot_warm_start_s"]
+
+    # telemetry-driven autoscaler: the diurnal-soak gates (opt-in but
+    # nearly free — the soak runs on a fake clock, zero real sleeps)
+    if os.environ.get("BENCH_AUTOSCALE", "0") == "1":
+        out["autoscale"] = autoscale_section()
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision

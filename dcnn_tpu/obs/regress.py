@@ -62,6 +62,12 @@ class MetricSpec:
     tolerance: Optional[float] = None  # None -> the gate's default
     guard: Optional[str] = None        # dotted path; must match to compare
     fallback: Optional[str] = None     # alternate path for older captures
+    # absolute slack added to the relative band. Essential for
+    # lower-is-better metrics that legitimately record 0.0 (zero SLO
+    # minutes, an un-delayed reaction): best=0 collapses the relative
+    # band to nothing and every later nonzero capture would flag
+    # REGRESSED forever
+    atol: float = 0.0
 
 
 # The ISSUE-mandated gate set: img/s, MFU, h2d bandwidth, compile wall,
@@ -101,6 +107,26 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
                guard="serving.router.replicas"),
     MetricSpec("serve_router_kill_availability",
                "serving.router.kill_soak.availability", tolerance=0.05),
+    # the autoscaler's diurnal soak (BENCH_AUTOSCALE=1, PR 11):
+    # availability through kill + canary + every fleet resize is
+    # correctness-adjacent like the kill soak, so its tolerance is
+    # tight; pre-PR-11 captures simply lack the `autoscale` block and
+    # are skipped, not lied about (the gate's absent-metric semantics).
+    MetricSpec("autoscale.availability", "autoscale.availability",
+               tolerance=0.01),
+    # atol: a clean capture records exactly 0.0 minutes/seconds (zero
+    # breach, un-delayed first reaction), and the soak gates both at the
+    # ~1-minute / one-cooldown budget — values inside the budget are
+    # operating-as-designed, not a regression against a perfect window
+    MetricSpec("autoscale.slo_violation_minutes",
+               "autoscale.slo_violation_minutes", higher_is_better=False,
+               tolerance=0.5, atol=1.0),
+    # reaction time is budgeted by the configured cooldown — comparing
+    # across different budgets would be a config change masquerading as
+    # a regression, so the guard pins the knob
+    MetricSpec("autoscale.scale_up_reaction_s",
+               "autoscale.scale_up_reaction_s", higher_is_better=False,
+               tolerance=0.5, guard="autoscale.up_cooldown_s", atol=5.0),
 )
 
 DEFAULT_TOLERANCE = 0.2
@@ -198,9 +224,9 @@ def compare(history: Sequence[Dict[str, Any]], *,
         best = max(vals) if spec.higher_is_better else min(vals)
         ratio = (float(cur) / best) if best else None
         if spec.higher_is_better:
-            regressed = float(cur) < best * (1.0 - tol)
+            regressed = float(cur) < best * (1.0 - tol) - spec.atol
         else:
-            regressed = float(cur) > best * (1.0 + tol)
+            regressed = float(cur) > best * (1.0 + tol) + spec.atol
         row.update({"window": list(reversed(vals)), "best": best,
                     "ratio": round(ratio, 4) if ratio is not None else None,
                     "verdict": "REGRESSED" if regressed else "ok"})

@@ -70,6 +70,17 @@ _HEALTH_FLAGS = (
     "serve_router_canary_replicas", "serve_router_version",
     "serve_router_replica_deaths_total", "serve_router_rejoins_total",
     "serve_router_rollbacks_total", "serve_router_promotions_total",
+    # autoscaler (serve/autoscale.py): is the loop in breach, what fleet
+    # size is it steering toward, and can it actually grow (lease/HBM
+    # pins surface as reasons via autoscale_check; these flags give the
+    # prober the numbers next to that verdict)
+    "autoscale_breach", "autoscale_replicas_target",
+    "autoscale_scale_ups_total", "autoscale_scale_downs_total",
+    "autoscale_lease_blocked_total", "autoscale_hbm_blocked_total",
+    "autoscale_last_scale_up_reaction_s",
+    "serve_router_decommissions_total",
+    "serve_router_decommission_sweeps_total",
+    "lease_free_devices",
 )
 
 

@@ -36,8 +36,9 @@ from .data_parallel import (
 )
 from .elastic import (
     ElasticController, EvictedError, Membership, PeerSpec,
-    WorldCollapsedError, microbatch_span, parse_peers,
+    PreemptedError, WorldCollapsedError, microbatch_span, parse_peers,
 )
+from .autoscale import LeasedElasticTrainer, TrainLease
 from .multihost import PeerLostError
 from .pipeline import (
     InProcessPipelineCoordinator, PipelineError, PipelineStage,
@@ -63,7 +64,9 @@ __all__ = [
     "make_data_parallel_train_step", "shard_batch", "replicate",
     "make_elastic_grad_step", "make_elastic_apply_step",
     "ElasticController", "Membership", "PeerSpec", "PeerLostError",
-    "EvictedError", "WorldCollapsedError", "microbatch_span", "parse_peers",
+    "EvictedError", "PreemptedError", "WorldCollapsedError",
+    "microbatch_span", "parse_peers",
+    "LeasedElasticTrainer", "TrainLease",
     "PipelineStage", "InProcessPipelineCoordinator", "PipelineError",
     "train_pipeline_batch_sync",
     "HeteroCompiledPipeline", "SequentialStageStack",

@@ -124,6 +124,17 @@ class EvictedError(RuntimeError):
     fight the new generation for the checkpoint directory."""
 
 
+class PreemptedError(RuntimeError):
+    """This host was asked to leave the training world — its device lease
+    was revoked by the autoscaler's broker (serving traffic spiked), or
+    the operator is draining the host. Raised from the step loop at the
+    next beat after :meth:`ElasticController.preempt`; the controller's
+    ``finally`` closes its membership, so the surviving peers observe
+    exactly a host death and reshape via the normal reconfiguration
+    protocol. The caller surrenders the device AFTER this surfaces —
+    never while the controller might still be writing checkpoints."""
+
+
 class WorldCollapsedError(RuntimeError):
     """Fewer survivors than ``elastic_min_world`` — the operator asked us
     not to limp on below this statistical-efficiency floor."""
@@ -479,6 +490,9 @@ class ElasticController:
         self._flat_size = 0
         self._init_snapshot = None
         self._last_saved_step = -1
+        # set by preempt() (any thread); checked at every step beat
+        self._preempt = threading.Event()
+        self._preempt_reason = "preempted"
 
     # -- plumbing ----------------------------------------------------------
     def _trip(self, point: str, **ctx) -> None:
@@ -493,6 +507,15 @@ class ElasticController:
 
     def is_leader(self) -> bool:
         return self.position == 0
+
+    def preempt(self, reason: str = "device lease revoked") -> None:
+        """Ask this controller to leave the world at the next step beat
+        (thread-safe; the device-lease twin in ``parallel/autoscale.py``
+        calls this from the broker's revocation path). The step loop
+        raises :class:`PreemptedError`, survivors reshape, and training
+        continues without this host."""
+        self._preempt_reason = reason
+        self._preempt.set()
 
     def _leader_rank(self) -> int:
         return self.survivors[0]
@@ -557,7 +580,18 @@ class ElasticController:
     # -- fit ---------------------------------------------------------------
     def fit(self, ts: Optional[TrainState] = None,
             epochs: Optional[int] = None, val_loader=None,
-            seed: Optional[int] = None) -> TrainState:
+            seed: Optional[int] = None, resume: bool = False
+            ) -> TrainState:
+        """Run the elastic epoch loop to (global) epoch ``epochs``.
+
+        ``resume=True`` restores the newest valid commit from the shared
+        checkpoint root before the first step and continues from its
+        (epoch, step, lr) — the segment-restart path the device-lease
+        twin uses to RE-GROW a world: a fresh, larger fleet picks up
+        exactly where the shrunken one stopped (every peer restores the
+        same commit; the cross-peer agreement check still applies at any
+        later reconfiguration). With no commit yet, resume is a no-op.
+        """
         # every host must pass the same seed (or the same cfg.seed) — the
         # epoch/step rng derivation below is what keeps peers in lockstep
         seed = seed if seed is not None else self.cfg.seed
@@ -569,14 +603,16 @@ class ElasticController:
         self._init_snapshot = jax.device_get(
             {"params": ts.params, "state": ts.state,
              "opt_state": ts.opt_state})
+        epoch, step = 1, 0
+        gs = 0
+        if resume and self.checkpoints is not None:
+            ts, epoch, step, gs, _ = self._restore()
         self.membership.connect_all(
             timeout=max(self.cfg.elastic_timeout_s * 4, 30.0))
         self._build(ts)
         self._reg.gauge("elastic_reconfiguring",
                         "1 while a reconfiguration is in flight").set(0)
         base_rng = jax.random.PRNGKey(seed)
-        epoch, step = 1, 0
-        gs = 0
         try:
             while epoch <= epochs:
                 plan = self._epoch_plan(epoch)
@@ -698,6 +734,13 @@ class ElasticController:
             self._save(ts, epoch + 1, 0, gs)
 
     def _beat(self, gs: int) -> None:
+        if self._preempt.is_set():
+            # leave at a step boundary: nothing half-sent, no checkpoint
+            # mid-write — peers see a clean host death on membership
+            # close and reshape without this rank
+            raise PreemptedError(
+                f"rank {self.rank} preempted at step {gs}: "
+                f"{self._preempt_reason}")
         # deterministic per-step beat — the elastic.heartbeat fault point
         # armed with InjectedCrash here IS the kill-a-host simulation
         self._trip("elastic.heartbeat", gen=self.gen, step=gs)
@@ -842,6 +885,10 @@ class ElasticController:
             step = int(md.get("step_in_epoch", 0))
             self.lr = float(md.get("lr", self.lr))
             ckpt_step = restored.step
+        # the restored commit already exists at ckpt_step: a fresh
+        # controller resuming a finished epoch must not re-save it (the
+        # committed-checkpoints-are-immutable guard would refuse)
+        self._last_saved_step = ckpt_step
         if expect_step is not None and ckpt_step != expect_step:
             raise RuntimeError(
                 f"survivors disagree on the restore point: leader restored "

@@ -42,11 +42,21 @@ On top of the single-replica stack sits the **router tier**
   (:class:`~dcnn_tpu.serve.swap.EngineFactory` builds the per-version
   engines).
 
+The telemetry-driven **autoscaler** (``autoscale.py``) closes the loop
+over all of it: scrapes every replica's ``/metrics`` exposition, grows
+the fleet against SLO targets through the AOT-warmed ``factory``,
+shrinks it with drain-then-remove decommission, and — via
+:class:`~dcnn_tpu.serve.autoscale.DeviceLeaseBroker` + the elastic twin
+in :mod:`dcnn_tpu.parallel.autoscale` — hands chips back and forth with
+the training world on shared hardware.
+
 End-to-end drivers: ``examples/serve_snapshot.py`` (committed digits28
 snapshot under open-loop traffic), ``examples/serve_router.py`` (the
-router tier: replica kill + rejoin + hot-swap), and ``BENCH_SERVE=1
-python bench.py`` (latency-vs-offered-load curve + ``router`` block).
-Quickstart: docs/deployment.md §5.
+router tier: replica kill + rejoin + hot-swap),
+``examples/serve_autoscale.py`` (the autoscaler's diurnal soak +
+device-lease handoff), and ``BENCH_SERVE=1 / BENCH_AUTOSCALE=1
+python bench.py`` (latency-vs-offered-load curve + ``router`` +
+``autoscale`` blocks). Quickstart: docs/deployment.md §5–6.
 """
 
 from .engine import InferenceEngine, serve_buckets
@@ -60,7 +70,11 @@ from .replica import (
 )
 from .router import NoReplicasError, Router, RouterShedError
 from .swap import EngineFactory, ModelVersionManager, newest_valid_version
-from .traffic import open_loop
+from .traffic import diurnal, open_loop, spike, step
+from .autoscale import (
+    Autoscaler, AutoscalerConfig, DeviceLeaseBroker, HttpScraper,
+    autoscale_check,
+)
 
 __all__ = [
     "InferenceEngine", "serve_buckets",
@@ -70,5 +84,7 @@ __all__ = [
     "ReplicaError", "ReplicaDeadError", "SwapError",
     "Router", "RouterShedError", "NoReplicasError",
     "EngineFactory", "ModelVersionManager", "newest_valid_version",
-    "open_loop",
+    "open_loop", "diurnal", "spike", "step",
+    "Autoscaler", "AutoscalerConfig", "DeviceLeaseBroker", "HttpScraper",
+    "autoscale_check",
 ]

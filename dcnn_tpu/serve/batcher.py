@@ -210,15 +210,22 @@ class DynamicBatcher:
         # once (flag-guarded — a second start_telemetry must not
         # double-count).
         reg = self.metrics.registry
-        self.engine._export_cost_gauges(reg)
+        # attribute-guarded: the batcher contract is duck-typed and a
+        # custom engine (e.g. the soak's SyntheticEngine) has no cost
+        # gauges or compile accounting to mirror — the scrape surface
+        # must still come up
+        if hasattr(self.engine, "_export_cost_gauges"):
+            self.engine._export_cost_gauges(reg)
         sample_hbm(reg)
-        if reg is not self.engine.registry and not self._compile_mirrored:
+        compile_stats = getattr(self.engine, "compile_stats", None)
+        if compile_stats and reg is not getattr(
+                self.engine, "registry", None) \
+                and not self._compile_mirrored:
             self._compile_mirrored = True
             secs = sum(st.get("compile_s", 0.0)
-                       for st in self.engine.compile_stats.values())
+                       for st in compile_stats.values())
             reg.counter("compile_total",
-                        "XLA executables compiled").inc(
-                len(self.engine.compile_stats))
+                        "XLA executables compiled").inc(len(compile_stats))
             reg.counter("compile_seconds_total",
                         "wall seconds spent compiling").inc(secs)
             reg.counter("compile_serve_seconds_total",
@@ -231,7 +238,7 @@ class DynamicBatcher:
             "version": getattr(self.engine, "version", None),
             "buckets": self.engine.bucket_sizes,
             "batch_invariant": self.engine.batch_invariant,
-            "compile_stats": self.engine.compile_stats,
+            "compile_stats": getattr(self.engine, "compile_stats", {}),
         })
         self._telemetry = srv.start()
         return srv

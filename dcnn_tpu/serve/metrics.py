@@ -286,6 +286,14 @@ class RouterMetrics:
         self._rollbacks = r.counter(
             "serve_router_rollbacks_total",
             "canary versions rolled back on regression")
+        self._decommissions = r.counter(
+            "serve_router_decommissions_total",
+            "replicas removed via graceful drain-then-remove")
+        self._decommission_sweeps = r.counter(
+            "serve_router_decommission_sweeps_total",
+            "decommissions that had to force-sweep outstanding work "
+            "(drain timeout or death mid-drain); the work failed typed "
+            "and re-admitted — never silently dropped")
         self.replicas = r.gauge("serve_router_replicas",
                                 "replicas known to the router")
         self.replicas_routable = r.gauge(
@@ -355,6 +363,11 @@ class RouterMetrics:
 
     def record_rollback(self) -> None:
         self._rollbacks.inc()
+
+    def record_decommission(self, clean: bool = True) -> None:
+        self._decommissions.inc()
+        if not clean:
+            self._decommission_sweeps.inc()
 
     # -- export --
     def snapshot(self) -> Dict[str, object]:

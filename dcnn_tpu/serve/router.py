@@ -94,7 +94,7 @@ class _Handle:
     def __init__(self, name: str, replica):
         self.name = name
         self.replica = replica
-        self.state = "up"            # up | unroutable | dead
+        self.state = "up"            # up | unroutable | draining | dead
         self.outstanding = 0         # rows dispatched, not yet settled
         self.completed = 0
         self.failed = 0
@@ -187,9 +187,92 @@ class Router:
             self._handles.pop(name, None)
             self._update_gauges_locked()
 
+    def decommission(self, name: str,
+                     timeout: Optional[float] = 30.0) -> Dict[str, Any]:
+        """Graceful scale-down: **drain, then remove** — the path the
+        autoscaler shrinks the fleet through, and the reason scale-down
+        can never violate the accepted-ledger no-silent-drop guarantee.
+
+        1. The victim goes ``draining``: admission capacity and routing
+           exclude it immediately (new traffic lands on the rest of the
+           fleet), but work already dispatched to it keeps running.
+        2. Wait (injectable clock/sleep, like :meth:`drain`) until every
+           outstanding row settles. A victim that dies mid-drain is
+           swept (``kill()``) so its accepted-but-unanswered requests
+           fail typed and **re-admit to survivors now** — same path as
+           an ejection.
+        3. On ``timeout`` the remainder is force-swept the same way —
+           typed failure + re-admission, never an orphan.
+        4. ``remove_replica``. Late settles are safe after removal: the
+           settle callback holds the handle object and the ledger, not
+           the fleet map.
+
+        The replica object is NOT closed (the caller — typically the
+        autoscaler, which built it — owns its lifecycle). Returns
+        ``{"drained": rows_settled_cleanly, "swept": rows_force_failed,
+        "was_dead": bool}``."""
+        with self._lock:
+            h = self._handles.get(name)
+            if h is None:
+                raise KeyError(f"no replica {name!r}")
+            was_dead = h.state == "dead"
+            start_outstanding = h.outstanding
+            if not was_dead:
+                h.state = "draining"
+                self._update_gauges_locked()
+        deadline = (self._clock() + timeout) if timeout is not None \
+            else None
+        swept = 0
+        while True:
+            with self._lock:
+                outstanding = h.outstanding
+                dead = h.state == "dead"
+            if outstanding <= 0:
+                break
+            if dead or (deadline is not None
+                        and self._clock() >= deadline):
+                # died mid-drain, or out of patience: sweep the replica's
+                # queue so everything it still holds fails typed and the
+                # settle path re-admits it to survivors — the ledger
+                # completes every accepted request either way
+                if not dead:
+                    self._note_dead(h, "decommission drain timed out")
+                swept = outstanding
+                try:
+                    h.replica.kill()
+                except Exception:
+                    pass
+                # give the sweep's synchronous settle callbacks (and a
+                # TCP replica's reader-side failure path) a bounded
+                # window to run down
+                grace = self._clock() + 5.0
+                while self._clock() < grace:
+                    with self._lock:
+                        if h.outstanding <= 0:
+                            break
+                    self._sleep(0.005)
+                break
+            self._sleep(0.005)
+        with self._lock:
+            self._handles.pop(name, None)
+            self._update_gauges_locked()
+        # clean == "no force-sweep happened": removing an already-settled
+        # corpse (was_dead, swept 0) is not a sweep and must not trip
+        # alerts on serve_router_decommission_sweeps_total
+        self.metrics.record_decommission(clean=swept == 0)
+        return {"drained": max(start_outstanding - swept, 0),
+                "swept": swept, "was_dead": was_dead}
+
     def replica_names(self) -> List[str]:
         with self._lock:
             return sorted(self._handles)
+
+    def replicas(self) -> Dict[str, Any]:
+        """Point-in-time ``{name: replica_object}`` snapshot — the
+        autoscaler's scrape pass reads each replica's exposition surface
+        through this (never the router's internals)."""
+        with self._lock:
+            return {h.name: h.replica for h in self._handles.values()}
 
     def _update_gauges_locked(self) -> None:
         m = self.metrics
@@ -514,6 +597,22 @@ class Router:
                 reason, hard_dead = f"health probe failed: {e}", True
             with self._lock:
                 state, auto = h.state, h.auto_rejoin
+            if state == "draining":
+                # mid-decommission: never flapped back to "up" by a
+                # passing probe (the decommission owns the state from
+                # here), but a death mid-drain is ejected NOW so its
+                # accepted work re-admits instead of waiting out the
+                # drain timeout
+                if hard_dead:
+                    self._note_dead(h, reason or "died while draining")
+                    try:
+                        r.kill()
+                    except Exception:
+                        pass
+                    report[h.name] = f"ejected mid-drain ({reason})"
+                else:
+                    report[h.name] = "draining (decommission in progress)"
+                continue
             if state == "dead":
                 if not hard_dead and reason is None and auto:
                     with self._lock:
@@ -581,6 +680,10 @@ class Router:
                 raise KeyError(f"no replica {name!r}")
             if h.state == "dead":
                 raise ReplicaDeadError(f"replica {name!r} is dead")
+            if h.state == "draining":
+                raise ReplicaError(
+                    f"replica {name!r} is being decommissioned; it cannot "
+                    f"take a version swap")
             h.state = "unroutable"
             self._update_gauges_locked()
         try:
@@ -598,9 +701,14 @@ class Router:
                     self._update_gauges_locked()
             raise
         with self._lock:
-            h.state = "up"
-            h.canary = canary
-            h.consecutive_failures = 0
+            # only an undisturbed swap rejoins: a concurrent decommission
+            # (state "draining") or death sweep (state "dead") that landed
+            # mid-load owns the handle now — resurrecting it to "up" would
+            # route new traffic at a replica being drained or killed
+            if h.state == "unroutable":
+                h.state = "up"
+                h.canary = canary
+                h.consecutive_failures = 0
             self._update_gauges_locked()
         self.metrics.record_swap(ok=True)
 

@@ -1,0 +1,227 @@
+"""The shared diurnal autoscale soak — one driver, three consumers.
+
+The ISSUE-11 acceptance story is an open-loop soak: a 10x peak-to-trough
+diurnal traffic curve through the router while the autoscaler breathes
+the fleet, with a replica preemption and a canary swap injected
+mid-load, gated on availability, SLO-violation minutes, and scale-up
+reaction time. This module IS that soak, shared verbatim by
+
+- ``tests/test_autoscale.py`` (tier-1: asserts the gates, sleep-free),
+- ``bench.py`` ``BENCH_AUTOSCALE=1`` (emits the ``autoscale`` block the
+  ``autoscale.*`` regression-gate keys read), and
+- ``examples/serve_autoscale.py`` (prints the fleet breathing),
+
+so the offered load, injected faults, and gate arithmetic are produced
+identically everywhere — the same contract ``traffic.open_loop``
+established for the constant-rate case in PR 2.
+
+Everything runs on a :class:`ManualClock` (no real sleeps): replica
+dispatchers are stepped on a fixed service cadence and the autoscaler is
+ticked on its own cadence by :func:`run_diurnal_soak`'s virtual-time
+event loop, so a four-minute soak takes well under a second of wall and
+is exactly reproducible. The replicas are real ``LocalReplica``s over a
+:class:`SyntheticEngine` (numpy ``x + version`` — the control loop under
+test is the router/autoscaler tier, not XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .autoscale import Autoscaler, AutoscalerConfig
+from .metrics import RouterMetrics, ServeMetrics
+from .replica import LocalReplica
+from .router import Router
+from .traffic import diurnal, open_loop
+
+
+class ManualClock:
+    """A monotonic clock advanced by hand — the injectable-clock twin of
+    ``time.monotonic`` every layer of the serve stack accepts."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class SyntheticEngine:
+    """Batcher-compatible engine without jax: logits = x + version.
+    Deterministic and instant, so soak outcomes measure the control
+    loop, not compute jitter."""
+
+    def __init__(self, version: Any = 1, name: str = "synthetic",
+                 features: int = 4):
+        self.input_shape = (features,)
+        self.max_batch = 8
+        self.bucket_sizes = [1, 2, 4, 8]
+        self.name = name
+        self.version = version
+        self.batch_invariant = True
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        raise ValueError(n)
+
+    def pad_to_bucket(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if b > n:
+            pad = np.zeros((b - n,) + x.shape[1:], np.float32)
+            x = np.concatenate([x, pad])
+        return x, n
+
+    def run_padded(self, x):
+        return np.asarray(x, np.float32) + (self.version or 0)
+
+
+def synthetic_engine_factory(version: Any) -> SyntheticEngine:
+    return SyntheticEngine(1 if version is None else version)
+
+
+def make_soak_replica_factory(clock: Callable[[], float], *,
+                              queue_capacity: int = 32,
+                              prefix: str = "as",
+                              window: Optional[int] = None
+                              ) -> Callable[[Any], LocalReplica]:
+    """``factory(version) -> LocalReplica`` over :class:`SyntheticEngine`
+    — the autoscaler's spin-up path in every soak consumer. ``window``
+    sizes the replica's ServeMetrics latency window (small windows age a
+    cleared overload out of the p99 breach verdict quickly)."""
+    made = [0]
+
+    def factory(version: Any) -> LocalReplica:
+        made[0] += 1
+        metrics = (ServeMetrics(window=window, clock=clock)
+                   if window is not None else None)
+        return LocalReplica(
+            synthetic_engine_factory, 1 if version is None else version,
+            name=f"{prefix}{made[0]}", queue_capacity=queue_capacity,
+            clock=clock, start=False, metrics=metrics)
+    return factory
+
+
+def run_diurnal_soak(*, seconds: float = 240.0, period: float = 240.0,
+                     peak: float = 200.0, trough: float = 20.0,
+                     service_dt: float = 0.1, tick_dt: float = 1.0,
+                     kill_at: Optional[float] = 100.0,
+                     canary_at: Optional[float] = 140.0,
+                     slo_p99_ms: float = 150.0,
+                     config: Optional[AutoscalerConfig] = None,
+                     on_tick: Optional[Callable[[float, int], None]] = None
+                     ) -> Tuple[Dict[str, Any], Autoscaler, Router]:
+    """The sleep-free acceptance soak (module docstring). Returns
+    ``(report, scaler, router)``; the report carries exactly the gate
+    keys the ``BENCH_AUTOSCALE`` block emits and the regression gate
+    reads (availability, slo_violation_minutes, scale_up_reaction_s,
+    plus the breathing evidence). ``kill_at``/``canary_at`` of ``None``
+    skip that injection; ``on_tick(t, fleet_size)`` observes each
+    autoscaler turn (the example's live printout)."""
+    fc = ManualClock()
+    # window=512: the replica p99 describes the last few seconds of
+    # traffic at soak rates, so a cleared overload ages out of the
+    # breach verdict quickly instead of pinning it for half a minute
+    factory = make_soak_replica_factory(fc, queue_capacity=32, window=512)
+    boot = factory(1)
+    cfg = config if config is not None else AutoscalerConfig(
+        slo_p99_ms=slo_p99_ms, max_shed_fraction=0.0,
+        high_utilization=0.70, low_utilization=0.20,
+        min_replicas=1, max_replicas=6,
+        up_cooldown_s=5.0, down_cooldown_s=20.0,
+        breach_ticks=1, idle_ticks=3, drain_timeout_s=2.0)
+
+    def pump_all():
+        for rep in router.replicas().values():
+            try:
+                rep.step(force=True)
+            except Exception:
+                pass
+
+    def router_sleep(dt):
+        fc.advance(dt)
+        pump_all()
+    # router_sleep closes over `router` by name — bound below, before any
+    # drain/decommission can call it
+    router = Router(clock=fc, sleep=router_sleep,
+                    metrics=RouterMetrics(clock=fc))
+    router.add_replica(boot)
+    scaler = Autoscaler(router, factory, config=cfg, clock=fc)
+
+    state = {"next_service": 0.0, "next_tick": 0.0, "killed": False,
+             "canaried": False, "fleet_sizes": [], "deaths": 0}
+
+    def drive_until(t_end):
+        while fc.t < t_end:
+            nxt = min(t_end, state["next_service"], state["next_tick"])
+            if fc.t < nxt:
+                fc.advance(nxt - fc.t)
+            if fc.t >= state["next_service"]:
+                pump_all()
+                state["next_service"] += service_dt
+            if fc.t >= state["next_tick"]:
+                if not state["killed"] and kill_at is not None \
+                        and fc.t >= kill_at:
+                    state["killed"] = True
+                    victims = [r for n, r in router.replicas().items()
+                               if not r.is_dead()]
+                    victims[-1].kill()     # preemption mid-load
+                    state["deaths"] += 1
+                if not state["canaried"] and canary_at is not None \
+                        and fc.t >= canary_at:
+                    state["canaried"] = True
+                    up = [n for n, st in router.replica_stats().items()
+                          if st["state"] == "up"]
+                    router.swap_replica(up[0], 2, canary=True)
+                scaler.tick()
+                fleet = sum(1 for st in router.replica_stats().values()
+                            if st["state"] == "up")
+                state["fleet_sizes"].append((fc.t, fleet))
+                if on_tick is not None:
+                    on_tick(fc.t, fleet)
+                state["next_tick"] += tick_dt
+
+    def soak_sleep(dt):
+        drive_until(fc.t + dt)
+
+    rate = diurnal(peak, trough, period_s=period)
+    samples = [np.full((4,), 7, np.float32)]
+    futs = open_loop(router, samples, rate, seconds,
+                     clock=fc, sleep=soak_sleep)
+    # run down the tail: no new arrivals, let everything settle
+    deadline = fc.t + 30.0
+    while router.outstanding() and fc.t < deadline:
+        drive_until(fc.t + service_dt)
+    accepted = len(futs)
+    completed = sum(1 for _, f in futs
+                    if f.done() and f.exception() is None)
+    typed = sum(1 for _, f in futs
+                if f.done() and f.exception() is not None)
+    undone = accepted - completed - typed
+    snap = scaler.router.metrics.registry.snapshot()
+    sizes = [n for _, n in state["fleet_sizes"]]
+    report = {
+        "accepted": accepted,
+        "completed": completed,
+        "typed_failures": typed,
+        "silently_dropped": undone,
+        "availability": completed / accepted if accepted else None,
+        "outstanding_after": router.outstanding(),
+        "scale_ups": snap["autoscale_scale_ups_total"],
+        "scale_downs": snap["autoscale_scale_downs_total"],
+        "slo_violation_minutes":
+            snap["autoscale_slo_violation_seconds_total"] / 60.0,
+        "reaction_max_s": snap["autoscale_scale_up_reaction_seconds"]["max"],
+        "peak_fleet": max(sizes),
+        "final_fleet": sizes[-1],
+        "shed": snap["serve_router_shed_normal_total"],
+    }
+    return report, scaler, router

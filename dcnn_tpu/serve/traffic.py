@@ -1,8 +1,9 @@
 """Open-loop traffic generation for serving measurement.
 
 One pacing loop, shared by every measurement surface
-(``examples/serve_snapshot.py``, ``bench.py serve_section``, the real-time
-soak test) so the load they report is generated identically.
+(``examples/serve_snapshot.py``, ``examples/serve_autoscale.py``,
+``bench.py`` serve/autoscale sections, the soak tests) so the load they
+report is generated identically.
 
 Open-loop means arrivals follow the offered rate regardless of
 completions — the honest way to measure an overloaded server: a closed
@@ -10,32 +11,112 @@ loop self-throttles to whatever the server sustains and hides exactly the
 queue growth that load shedding exists to bound. When the generator falls
 behind schedule (a slow ``submit`` or scheduler hiccup) it does not sleep
 until it has caught back up, preserving the offered average rate.
+
+``offered_rps`` may be a constant (the PR-2 contract, unchanged) or a
+**rate schedule** — any ``f(t_rel) -> rps`` over seconds since the run
+started. The schedule constructors below (:func:`diurnal`,
+:func:`spike`, :func:`step`) are the shared vocabulary of the autoscaler
+example, the ``BENCH_AUTOSCALE`` bench block, and the diurnal soak test,
+so all three offer byte-identical load for the same parameters. Pacing
+under a schedule integrates arrival-by-arrival: the gap after an arrival
+at ``t`` is ``1 / rate(t)``, so the instantaneous offered rate tracks
+the schedule exactly (not a stair-step average over the run).
 """
 
 from __future__ import annotations
 
+import math
 import time
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple, Union
 
 from .batcher import DynamicBatcher, QueueFullError
 
+#: A time-varying offered rate: seconds since the run started -> rps.
+RateFn = Callable[[float], float]
 
-def open_loop(batcher: DynamicBatcher, samples: Sequence, offered_rps: float,
-              seconds: float, *, clock: Callable[[], float] = time.monotonic,
+
+def diurnal(peak_rps: float, trough_rps: float, period_s: float, *,
+            phase_s: float = 0.0) -> RateFn:
+    """Sinusoidal day/night curve between ``trough_rps`` and ``peak_rps``
+    with period ``period_s``; the run starts at the trough (shift with
+    ``phase_s``). ``peak/trough`` is the peak-to-trough ratio the
+    autoscale soak gates on (10x in the acceptance run)."""
+    if not 0 < trough_rps <= peak_rps:
+        raise ValueError(f"need 0 < trough <= peak, got "
+                         f"{trough_rps}/{peak_rps}")
+    if period_s <= 0:
+        raise ValueError(f"period_s must be > 0, got {period_s}")
+    mid = (peak_rps + trough_rps) / 2.0
+    amp = (peak_rps - trough_rps) / 2.0
+
+    def rate(t: float) -> float:
+        # cos starts at the trough: -cos(0) = -1
+        return mid - amp * math.cos(2.0 * math.pi * (t + phase_s)
+                                    / period_s)
+    return rate
+
+
+def spike(base_rps: float, spike_rps: float, at_s: float,
+          width_s: float) -> RateFn:
+    """Flat ``base_rps`` with a rectangular burst to ``spike_rps`` over
+    ``[at_s, at_s + width_s)`` — the traffic-surge fixture the device
+    lease handoff test drives."""
+    if base_rps <= 0 or spike_rps <= 0:
+        raise ValueError("rates must be > 0")
+    if width_s <= 0:
+        raise ValueError(f"width_s must be > 0, got {width_s}")
+
+    def rate(t: float) -> float:
+        return spike_rps if at_s <= t < at_s + width_s else base_rps
+    return rate
+
+
+def step(levels: Sequence[Tuple[float, float]]) -> RateFn:
+    """Piecewise-constant schedule from ``(from_s, rps)`` pairs: the rate
+    holds each level from its start time until the next level's. The
+    first level must start at 0 so the rate is defined everywhere."""
+    lv = sorted((float(t), float(r)) for t, r in levels)
+    if not lv or lv[0][0] != 0.0:
+        raise ValueError("levels must be non-empty and start at t=0")
+    if any(r <= 0 for _, r in lv):
+        raise ValueError("every level's rps must be > 0")
+
+    def rate(t: float) -> float:
+        cur = lv[0][1]
+        for start, r in lv:
+            if t < start:
+                break
+            cur = r
+        return cur
+    return rate
+
+
+def open_loop(batcher: DynamicBatcher, samples: Sequence,
+              offered_rps: Union[float, RateFn], seconds: float, *,
+              clock: Callable[[], float] = time.monotonic,
               sleep: Callable[[float], None] = time.sleep
               ) -> List[Tuple[int, "object"]]:
-    """Submit single-sample requests from ``samples`` (cycled) at a fixed
-    offered rate for ``seconds``. Returns ``[(sample_index, future), ...]``
-    for every accepted request; shed requests are counted by the batcher's
-    metrics. ``clock``/``sleep`` are injectable like everywhere else in
-    the serve stack."""
-    if offered_rps <= 0:
-        raise ValueError(f"offered_rps must be > 0, got {offered_rps}")
+    """Submit single-sample requests from ``samples`` (cycled) at the
+    offered rate (constant or a :data:`RateFn` schedule) for ``seconds``.
+    Returns ``[(sample_index, future), ...]`` for every accepted request;
+    shed requests are counted by the batcher's metrics. ``clock``/
+    ``sleep`` are injectable like everywhere else in the serve stack."""
+    if callable(offered_rps):
+        rate: RateFn = offered_rps
+        if rate(0.0) <= 0:
+            raise ValueError("rate schedule must be > 0 at t=0")
+    else:
+        if offered_rps <= 0:
+            raise ValueError(f"offered_rps must be > 0, got {offered_rps}")
+        rate = lambda t, r=float(offered_rps): r  # noqa: E731
     futs: List[Tuple[int, object]] = []
     t0 = clock()
-    t_next, i = t0, 0
-    while t_next < t0 + seconds:
-        dt = t_next - clock()
+    # schedule time accumulates on a nanosecond grid: without the
+    # rounding, fifty 0.1s gaps land at 4.999999999999998 and a schedule
+    # breakpoint at t=5.0 is evaluated one full slow-rate gap late
+    t_rel, i = 0.0, 0
+    while t_rel < seconds:
+        dt = (t0 + t_rel) - clock()
         if dt > 0:
             sleep(dt)
         k = i % len(samples)
@@ -44,5 +125,17 @@ def open_loop(batcher: DynamicBatcher, samples: Sequence, offered_rps: float,
         except QueueFullError:
             pass  # shed — the valve working as designed
         i += 1
-        t_next += 1.0 / offered_rps
+        r = rate(t_rel)
+        if not (r > 0):          # also catches NaN
+            raise ValueError(f"rate schedule returned {r} at "
+                             f"t={t_rel:.3f}; rates must stay > 0")
+        nxt = round(t_rel + 1.0 / r, 9)
+        if nxt <= t_rel:
+            # inf or >~2e9 rps: the per-arrival gap rounds to zero on
+            # the nanosecond grid — raising beats spinning forever
+            raise ValueError(
+                f"rate schedule returned {r} rps at t={t_rel:.3f}; "
+                f"the per-arrival gap rounds to zero on the nanosecond "
+                f"grid")
+        t_rel = nxt
     return futs

@@ -1,0 +1,1145 @@
+"""Telemetry-driven autoscaler (dcnn_tpu/serve/autoscale.py + the
+elastic-training twin in dcnn_tpu/parallel/autoscale.py).
+
+Contracts:
+
+- **Rate schedules** (`serve/traffic.py`): diurnal/spike/step produce the
+  documented instantaneous rates and `open_loop` paces arrivals to the
+  schedule exactly (fake clock — arrival counts per window are asserted,
+  not approximated).
+- **Graceful scale-down**: `Router.decommission` is drain-then-remove —
+  the accepted-ledger no-silent-drop guarantee holds through a shrink,
+  including a victim killed mid-decommission (its work re-admits).
+- **Device leases**: strict-priority broker; a serving shortfall revokes
+  from training (edge-triggered, never duplicated), training never
+  surrenders below its floor, release un-blocks the claimant.
+- **Control loop**: scale-up on SLO breach after `breach_ticks` within
+  the cooldown, scale-down only after `idle_ticks` of genuine idleness,
+  hysteresis band enforced at construction, HBM watermark guard, canary
+  replicas never chosen as scale-down victims, scale-ups join the modal
+  *stable* version.
+- **The diurnal soak** (acceptance): 10x peak-to-trough over a full
+  cycle with a replica preemption and a canary swap injected mid-load,
+  all sleep-free under a fake clock — availability >= 0.999, zero
+  silent drops, bounded SLO-violation minutes, scale-up reaction within
+  the cooldown budget, and the fleet actually breathing (grows at peak,
+  shrinks back at trough). A real-time variant runs under `-m slow`.
+- **Device-lease handoff** (acceptance): the serving autoscaler's
+  scale-up revokes a chip from a live elastic training world, which
+  shrinks via the PR-8 reconfiguration protocol and keeps training;
+  when load recedes the chip returns and the world re-grows from the
+  shared checkpoint root — final params match an uninterrupted
+  fixed-world run within the PR-8 reshard tolerance.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.obs.exposition import (
+    parse_prometheus_text, render_scalar, scalar_values,
+)
+from dcnn_tpu.obs.registry import MetricsRegistry
+from dcnn_tpu.serve import (
+    Autoscaler, AutoscalerConfig, DeviceLeaseBroker, LocalReplica, Router,
+    RouterMetrics, autoscale_check, diurnal, open_loop, spike, step,
+)
+from dcnn_tpu.serve.replica import ReplicaError
+from dcnn_tpu.serve.soak import (
+    ManualClock as FakeClock,
+    make_soak_replica_factory as make_replica_factory,
+    run_diurnal_soak,
+    synthetic_engine_factory as fake_engine_factory,
+)
+
+
+# ------------------------------------------------------------ rate schedules
+
+def test_diurnal_schedule_shape():
+    rate = diurnal(400.0, 40.0, period_s=600.0)
+    assert rate(0.0) == pytest.approx(40.0)        # starts at the trough
+    assert rate(300.0) == pytest.approx(400.0)     # peak at half period
+    assert rate(600.0) == pytest.approx(40.0)      # full cycle
+    assert rate(300.0) / rate(0.0) == pytest.approx(10.0)  # 10x ratio
+    with pytest.raises(ValueError, match="trough"):
+        diurnal(10.0, 20.0, period_s=60.0)
+
+
+def test_spike_and_step_schedules():
+    r = spike(10.0, 100.0, at_s=5.0, width_s=2.0)
+    assert r(4.9) == 10.0 and r(5.0) == 100.0
+    assert r(6.9) == 100.0 and r(7.0) == 10.0
+    s = step([(0.0, 5.0), (10.0, 50.0), (20.0, 2.0)])
+    assert s(0.0) == 5.0 and s(9.9) == 5.0
+    assert s(10.0) == 50.0 and s(25.0) == 2.0
+    with pytest.raises(ValueError, match="start at t=0"):
+        step([(1.0, 5.0)])
+
+
+def test_open_loop_paces_to_the_schedule():
+    """Arrival counts per window match the schedule's integral — the
+    offered-load contract every measurement surface shares."""
+    fc = FakeClock()
+
+    class CountingSink:
+        def __init__(self):
+            self.times = []
+
+        def submit(self, x):
+            self.times.append(fc.t)
+            from concurrent.futures import Future
+            f = Future()
+            f.set_result(x)
+            return f
+
+    sink = CountingSink()
+    rate = step([(0.0, 10.0), (5.0, 100.0)])
+    open_loop(sink, [np.zeros(4, np.float32)], rate, 10.0,
+              clock=fc, sleep=fc.advance)
+    first = sum(1 for t in sink.times if t < 4.99)
+    second = sum(1 for t in sink.times if t >= 4.99)
+    assert abs(first - 50) <= 1       # 10 rps x 5 s
+    assert abs(second - 500) <= 1     # 100 rps x 5 s
+    # constant-rate back-compat: a float still works unchanged
+    sink2 = CountingSink()
+    fc.t = 0.0
+    open_loop(sink2, [np.zeros(4, np.float32)], 20.0, 2.0,
+              clock=fc, sleep=fc.advance)
+    assert len(sink2.times) == 40
+
+
+# --------------------------------------------------- graceful decommission
+
+def make_fleet(n=3, *, queue_capacity=16, pump_on_sleep=True):
+    fc = FakeClock()
+    factory = make_replica_factory(fc, queue_capacity=queue_capacity,
+                                   prefix="r")
+    reps = [factory(1) for _ in range(n)]
+
+    def sleep(dt):
+        fc.advance(dt)
+        if pump_on_sleep:
+            for r in reps:
+                try:
+                    r.step(force=True)
+                except Exception:
+                    pass
+    router = Router(reps, clock=fc, sleep=sleep)
+    return router, reps, fc
+
+
+def pump(reps, rounds=4):
+    for _ in range(rounds):
+        for r in reps:
+            try:
+                while r.step():
+                    pass
+            except Exception:
+                pass
+
+
+def test_decommission_drains_then_removes():
+    router, reps, _fc = make_fleet(3)
+    futs = [router.submit(np.full((4,), i, np.float32)) for i in range(24)]
+    victim = reps[0].name
+    report = router.decommission(victim, timeout=5.0)
+    assert victim not in router.replica_names()
+    assert report["swept"] == 0  # everything drained cleanly
+    pump(reps)
+    assert router.outstanding() == 0
+    for f in futs:
+        assert f.done() and f.exception() is None
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_decommissions_total"] == 1
+    assert snap["serve_router_decommission_sweeps_total"] == 0
+
+
+def test_decommission_stops_admission_to_victim_immediately():
+    router, reps, _fc = make_fleet(2, pump_on_sleep=False)
+    victim = reps[0].name
+    # mark draining in a thread; it blocks on outstanding=0 never needed
+    # here (no outstanding) — decommission returns immediately
+    router.decommission(victim, timeout=1.0)
+    for i in range(8):
+        router.submit(np.full((4,), i, np.float32))
+    pump(reps)
+    stats = router.replica_stats()
+    assert reps[0].name not in stats          # removed
+    assert stats[reps[1].name]["completed"] == 8  # all routed to survivor
+
+
+def test_kill_draining_replica_mid_decommission_no_silent_drops():
+    """The ISSUE's regression case: the victim dies WHILE draining — its
+    accepted-but-unanswered requests must fail typed and re-admit to
+    survivors, never silently drop."""
+    router, reps, fc = make_fleet(2, pump_on_sleep=False)
+    victim = reps[0]
+    # load work onto both replicas, none of it dispatched yet
+    futs = [router.submit(np.full((4,), i, np.float32)) for i in range(16)]
+
+    kills = [0]
+
+    def killer_sleep(dt):
+        fc.advance(dt)
+        if kills[0] == 0:
+            kills[0] = 1
+            victim.kill()      # dies mid-drain
+        pump([reps[1]])        # survivor keeps serving
+    router._sleep = killer_sleep
+    router.decommission(victim.name, timeout=5.0)
+    pump([reps[1]])
+    assert router.outstanding() == 0      # ledger swept
+    undone = [f for f in futs if not f.done()]
+    assert undone == []                    # zero silent drops
+    completed = sum(1 for f in futs if f.exception() is None)
+    assert completed == 16                 # everything re-admitted + served
+    assert victim.name not in router.replica_names()
+
+
+def test_decommission_timeout_sweeps_typed():
+    router, reps, _fc = make_fleet(2, pump_on_sleep=False)
+    victim = reps[0]
+    futs = [router.submit(np.full((4,), i, np.float32)) for i in range(8)]
+
+    def sleep(dt):
+        _fc.advance(dt)
+        pump([reps[1]])  # only the survivor is ever pumped
+    router._sleep = sleep
+    report = router.decommission(victim.name, timeout=0.5)
+    pump([reps[1]])
+    # whatever the victim still held was swept typed and re-admitted
+    assert router.outstanding() == 0
+    assert all(f.done() for f in futs)
+    assert sum(1 for f in futs if f.exception() is None) == 8
+    if report["swept"]:
+        snap = router.metrics.registry.snapshot()
+        assert snap["serve_router_decommission_sweeps_total"] == 1
+
+
+def test_draining_replica_not_flapped_up_by_sweep():
+    router, reps, _fc = make_fleet(2, pump_on_sleep=False)
+    victim = reps[0]
+    for i in range(4):  # least-loaded routing spreads these over both
+        router.submit(np.full((4,), i, np.float32))
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(
+            router.decommission(victim.name, timeout=None)), daemon=True)
+    # hold the drain open: outstanding > 0 until we pump
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        stats = router.replica_stats()
+        if victim.name in stats \
+                and stats[victim.name]["state"] == "draining":
+            break
+        time.sleep(0.005)
+    report = router.check_replicas()
+    assert "draining" in report[victim.name]
+    stats = router.replica_stats()
+    assert stats[victim.name]["state"] == "draining"  # sweep left it alone
+    with pytest.raises(ReplicaError, match="decommissioned"):
+        router.swap_replica(victim.name, 2)
+    pump(reps)
+    t.join(timeout=5.0)
+    assert not t.is_alive() and done
+
+
+# ------------------------------------------------------- device-lease broker
+
+def test_broker_grant_release_and_priority_revocation():
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(4, registry=reg)
+    revokes = []
+    broker.register("train", priority=0, held=3,
+                    on_revoke=lambda k: revokes.append(k))
+    broker.register("serve", priority=1, held=1)
+    assert broker.free() == 0
+    # serving shortfall fires a revocation at the training tenant
+    assert broker.request("serve", 1) == 0
+    assert revokes == [1]
+    assert broker.revoke_pending("train") == 1
+    # edge-triggered: a second identical request does not re-revoke
+    assert broker.request("serve", 1) == 0
+    assert revokes == [1]
+    # training surrenders; the claimant's next poll gets the device
+    broker.release("train", 1)
+    assert broker.revoke_pending("train") == 0
+    assert broker.request("serve", 1) == 1
+    assert broker.held("serve") == 2 and broker.held("train") == 2
+    # release back and training can re-grow
+    broker.release("serve", 1)
+    assert broker.request("train", 1) == 1
+    # training (low priority) shortfall never revokes from serving
+    revokes.clear()
+    assert broker.request("train", 1) == 0
+    assert revokes == []
+    with pytest.raises(ValueError, match="cannot release"):
+        broker.release("serve", 99)
+    with pytest.raises(KeyError):
+        broker.request("ghost", 1)
+    assert reg.snapshot()["lease_revocations_total"] == 1
+
+
+def test_train_lease_floor_and_listener():
+    from dcnn_tpu.parallel import TrainLease
+
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    lease = TrainLease(broker, initial=2, min_hold=1, registry=reg)
+    broker.register("serve", priority=1, held=0)
+    seen = []
+    lease.add_listener(seen.append)
+    # asking for 2 only surfaces 1 to training (min_hold floor)
+    assert broker.request("serve", 2) == 0
+    assert seen == [1]
+    lease.surrender(1)
+    assert broker.request("serve", 2) == 1   # the surrendered one
+    assert lease.held() == 1
+    # a further shortfall cannot dig below the floor
+    assert broker.request("serve", 1) == 0
+    assert seen == [1]
+    assert reg.snapshot()["train_lease_preemptions_total"] == 1
+
+
+# ------------------------------------------------------- control-loop units
+
+def _breach_text(p99=1000.0, depth=30.0, shed=0.0, hbm=None):
+    lines = []
+    lines += render_scalar("serve_queue_depth", "gauge", depth)
+    lines += render_scalar("serve_latency_window_p99_ms", "gauge", p99)
+    lines += render_scalar("serve_shed_fraction", "gauge", shed)
+    if hbm is not None:
+        lines += render_scalar("hbm_bytes_in_use", "gauge", hbm * 100.0)
+        lines += render_scalar("hbm_bytes_limit", "gauge", 100.0)
+    return "\n".join(lines) + "\n"
+
+
+def _idle_text():
+    return _breach_text(p99=1.0, depth=0.0)
+
+
+def make_scaler(fc, *, cfg=None, scrape=None, broker=None, n_boot=1,
+                factory=None):
+    factory = factory if factory is not None else make_replica_factory(fc)
+    reps = [factory(1) for _ in range(n_boot)]
+    router = Router(reps, clock=fc, sleep=lambda s: fc.advance(s),
+                    metrics=RouterMetrics(clock=fc))
+    scaler = Autoscaler(
+        router, factory,
+        config=cfg if cfg is not None else AutoscalerConfig(
+            up_cooldown_s=0.0, down_cooldown_s=0.0, breach_ticks=1,
+            idle_ticks=2, max_replicas=4),
+        broker=broker, clock=fc,
+        scrape=scrape if scrape is not None else (lambda n, r: None))
+    return scaler, router, reps
+
+
+def test_http_scraper_reads_real_telemetry_endpoints():
+    """HttpScraper — the production scrape path — against a real
+    per-replica telemetry server: ``/metrics`` text feeds the same parse
+    path as the in-process scrape, ``healthz()`` surfaces both healthy
+    bodies and a 503's machine-readable degradation reasons, and an
+    unreachable or unknown replica scores as signal-less (``None``),
+    never an exception."""
+    from dcnn_tpu.obs import TelemetryServer
+    from dcnn_tpu.serve.autoscale import HttpScraper
+    from dcnn_tpu.serve.batcher import DynamicBatcher
+
+    b = DynamicBatcher(fake_engine_factory(1), start=False)
+    srv = b.start_telemetry()
+    degraded = TelemetryServer(registry=MetricsRegistry())
+    degraded.add_check("scaler", lambda: "scale-up blocked: no lease")
+    degraded.start()
+    try:
+        fut = b.submit(np.zeros((4,), np.float32))
+        b.step()
+        fut.result(timeout=10)
+        scraper = HttpScraper({"r0": srv.url, "bad": degraded.url,
+                               "gone": "http://127.0.0.1:9"})
+        vals = scalar_values(parse_prometheus_text(scraper("r0", None)))
+        assert vals["serve_samples_completed_total"] == 1
+        assert "serve_queue_depth" in vals
+        assert scraper.healthz("r0")["status"] == "ok"
+        # a 503 still yields the parsed degradation body (HTTPError path)
+        body = scraper.healthz("bad")
+        assert body["status"] == "unhealthy"
+        assert any("no lease" in r for r in body["reasons"])
+        # unreachable / unregistered -> None, never an exception
+        assert scraper("gone", None) is None
+        assert scraper.healthz("gone") is None
+        assert scraper("unknown", None) is None
+    finally:
+        degraded.stop()
+        b.shutdown(drain=False)
+
+
+def test_open_loop_rejects_rate_too_fast_for_the_grid():
+    """A schedule bug returning inf (or >~2e9 rps) must raise, not spin
+    the pacing loop forever on a zero-length nanosecond step."""
+    fc = FakeClock()
+
+    class Sink:
+        def submit(self, x):
+            from concurrent.futures import Future
+            f = Future()
+            f.set_result(x)
+            return f
+
+    rate = step([(0.0, 10.0), (1.0, float("inf"))])
+    with pytest.raises(ValueError, match="rounds to zero"):
+        open_loop(Sink(), [np.zeros(4, np.float32)], rate, 5.0,
+                  clock=fc, sleep=fc.advance)
+
+
+def test_scaler_scales_up_on_breach_and_down_when_idle():
+    fc = FakeClock()
+    mode = {"text": _breach_text()}
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: mode["text"])
+    out = scaler.tick()
+    assert out["action"] == "up" and len(router.replica_names()) == 2
+    fc.advance(1.0)
+    mode["text"] = _idle_text()
+    assert scaler.tick()["action"] == "hold"   # idle_ticks=2: not yet
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "down"
+    assert len(router.replica_names()) == 1
+    # never below min_replicas
+    fc.advance(1.0)
+    scaler.tick()
+    fc.advance(1.0)
+    assert scaler.tick()["action"] == "hold"
+    assert len(router.replica_names()) == 1
+    snap = scaler.router.metrics.registry.snapshot()
+    assert snap["autoscale_scale_ups_total"] == 1
+    assert snap["autoscale_scale_downs_total"] == 1
+
+
+def test_scaler_cooldowns_and_breach_ticks():
+    fc = FakeClock()
+    cfg = AutoscalerConfig(up_cooldown_s=10.0, breach_ticks=2,
+                           max_replicas=4)
+    scaler, router, _ = make_scaler(
+        fc, cfg=cfg, scrape=lambda n, r: _breach_text())
+    assert scaler.tick()["action"] == "hold"       # 1 breach tick < 2
+    fc.advance(1.0)
+    assert scaler.tick()["action"] == "up"         # 2nd consecutive tick
+    fc.advance(1.0)
+    assert scaler.tick()["action"] == "hold"       # up cooldown
+    fc.advance(10.0)
+    assert scaler.tick()["action"] == "up"         # cooldown expired
+    assert len(router.replica_names()) == 3
+
+
+def test_scaler_hbm_watermark_guard_blocks_up():
+    fc = FakeClock()
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: _breach_text(hbm=0.97))
+    out = scaler.tick()
+    assert out["action"] == "blocked" and out["reason"] == "hbm watermark"
+    assert len(router.replica_names()) == 1
+    assert "hbm" in (autoscale_check(scaler)() or "")
+    snap = scaler.router.metrics.registry.snapshot()
+    assert snap["autoscale_hbm_blocked_total"] == 1
+    # the block is per-turn: once the next tick no longer wants that
+    # scale-up, a stale reason must not pin /healthz degraded
+    scaler.scrape = lambda n, r: _idle_text()
+    fc.advance(1.0)
+    scaler.tick()
+    assert autoscale_check(scaler)() is None
+
+
+def test_scaler_lease_blocked_then_granted():
+    fc = FakeClock()
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    broker.register("other", priority=0, held=1)
+    broker.register("serve", priority=1, held=1)
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: _breach_text(), broker=broker)
+    out = scaler.tick()
+    assert out["action"] == "blocked" and out["reason"] == "awaiting lease"
+    assert "lease" in autoscale_check(scaler)()
+    broker.release("other", 1)
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "up"
+    assert broker.held("serve") == 2
+    # scale-down releases the lease back
+    fc.advance(1.0)
+    mode_idle = _idle_text()
+    scaler.scrape = lambda n, r: mode_idle
+    scaler.tick()              # idle_run 1 of 2
+    fc.advance(1.0)
+    out = scaler.tick()        # idle_run 2 -> down
+    assert out["action"] == "down"
+    assert broker.held("serve") == 1 and broker.free() == 1
+
+
+def test_scaler_reaps_dead_owned_replica_and_returns_lease():
+    """An owned replica that dies (the soak's preemption) must be
+    reclaimed on the next tick — removed from the fleet map, closed, and
+    its device lease released — or the lease would leak forever:
+    _scale_down only ever considers routable victims."""
+    fc = FakeClock()
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    broker.register("serve", priority=1, held=1)
+    mode = {"text": _breach_text()}
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: mode["text"], broker=broker)
+    out = scaler.tick()
+    assert out["action"] == "up" and broker.held("serve") == 2
+    victim = out["added"][0]
+    router.replicas()[victim].kill()
+    mode["text"] = _idle_text()
+    fc.advance(1.0)
+    scaler.tick()
+    assert victim not in router.replica_names()
+    assert scaler.owned_replicas() == []
+    assert broker.held("serve") == 1 and broker.free() == 1
+
+
+def test_scaler_version_fn_failure_does_not_strand_leases():
+    """A raising version_fn aborts the turn BEFORE any lease is taken —
+    the grant must not escape to tick()'s catch-all unreleased."""
+    fc = FakeClock()
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    broker.register("serve", priority=1, held=1)
+
+    def bad_version():
+        raise RuntimeError("version store unreachable")
+
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: _breach_text(), broker=broker)
+    scaler.version_fn = bad_version
+    out = scaler.tick()
+    assert out["action"] == "error"
+    assert "version store unreachable" in (autoscale_check(scaler)() or "")
+    assert broker.held("serve") == 1 and broker.free() == 1
+    assert len(router.replica_names()) == 1
+
+
+def test_scaler_never_picks_canary_victim_and_joins_stable_version():
+    fc = FakeClock()
+    factory = make_replica_factory(fc)
+    scaler, router, reps = make_scaler(
+        fc, factory=factory, n_boot=2,
+        cfg=AutoscalerConfig(up_cooldown_s=0.0, down_cooldown_s=0.0,
+                             breach_ticks=1, idle_ticks=1,
+                             max_replicas=4, min_replicas=1))
+    # one replica is mid-canary on v2
+    router.swap_replica(reps[0].name, 2, canary=True)
+    mode = {"text": _breach_text()}
+    scaler.scrape = lambda n, r: mode["text"]
+    out = scaler.tick()
+    assert out["action"] == "up"
+    # the new replica joined the modal STABLE version (1), not the canary
+    added = out["added"][0]
+    assert router.replica_stats()[added]["version"] == 1
+    # scale-down: victim must never be the canary
+    mode["text"] = _idle_text()
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "down"
+    assert out["removed"] != reps[0].name
+    assert router.replica_stats()[reps[0].name]["canary"]
+
+
+def test_collect_is_read_only_for_out_of_band_callers():
+    """A dashboard polling the public collect() between ticks must not
+    consume the router's shed delta — only the decision loop commits the
+    baseline, so the next tick still sees the breach."""
+    fc = FakeClock()
+    scaler, router, _ = make_scaler(fc, scrape=lambda n, r: _idle_text())
+    scaler.tick()                      # baseline committed at zero
+    router.metrics.record_submit("normal", 10)
+    router.metrics.record_shed("normal", 10)
+    fleet = scaler.collect()           # out-of-band observer
+    assert fleet.shed_fraction == pytest.approx(0.5)
+    fc.advance(1.0)
+    out = scaler.tick()                # the delta was NOT consumed
+    assert out["shed_fraction"] == pytest.approx(0.5)
+    assert out["action"] == "up"       # shed breach still fires
+    # scrape health is decision state too: a dashboard poll seeing a
+    # malformed body must not degrade /healthz (and a poll seeing a
+    # clean one must not clear a tick's degradation)
+    scaler.scrape = lambda n, r: "torn mid-write garbage\n"
+    scaler.collect()
+    assert autoscale_check(scaler)() is None
+    snap = scaler.router.metrics.registry.snapshot()
+    assert snap.get("autoscale_scrape_parse_failures_total", 0) == 0
+
+
+def test_down_guard_refuses_shrink_while_traffic_needs_fleet():
+    """Instantaneous queues read ~0 on a fleet that is keeping up: the
+    down decision must project the post-shrink per-replica offered rate
+    against the last scale-up's demand watermark, not decommission at
+    steady peak and pay a breach + re-grow limit cycle."""
+    fc = FakeClock()
+    mode = {"text": _idle_text()}
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: mode["text"],
+        cfg=AutoscalerConfig(up_cooldown_s=0.0, down_cooldown_s=0.0,
+                             breach_ticks=1, idle_ticks=1,
+                             max_replicas=4))
+    scaler.tick()                      # priming tick (dt starts here)
+    # breach under 100 rps -> scale up 1 -> 2; watermark = 100/2 = 50
+    router.metrics.record_submit("normal", 100)
+    mode["text"] = _breach_text()
+    fc.advance(1.0)
+    assert scaler.tick()["action"] == "up"
+    # queues drain (idle text) but traffic continues at peak: shrinking
+    # to 1 replica would put 100 rps on a 50-rps watermark -> hold
+    mode["text"] = _idle_text()
+    router.metrics.record_submit("normal", 100)
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "hold" and out["reason"] == "traffic needs fleet"
+    assert len(router.replica_names()) == 2
+    # traffic recedes -> the same idle verdict now shrinks the fleet
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "down"
+    assert len(router.replica_names()) == 1
+
+
+def test_miswired_lease_release_surfaces_without_failing_the_turn():
+    """An operator who registered the serve tenant with held=0 (the
+    convention wants held=<bootstrap fleet>) makes a bootstrap-victim
+    scale-down's lease release an accounting error — the shrink already
+    happened, so the turn completes and the error surfaces on
+    /healthz instead of aborting mid-decommission."""
+    fc = FakeClock()
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    broker.register("serve", priority=1, held=0)   # mis-wired: no held
+    mode = {"text": _idle_text()}
+    scaler, router, _ = make_scaler(
+        fc, scrape=lambda n, r: mode["text"], n_boot=2, broker=broker)
+    scaler.tick()                      # idle_run 1 of 2
+    fc.advance(1.0)
+    out = scaler.tick()                # idle_run 2 -> down
+    assert out["action"] == "down"     # the shrink landed
+    assert len(router.replica_names()) == 1
+    assert "lease release failed" in (autoscale_check(scaler)() or "")
+
+
+def test_scrape_parse_failure_degrades_healthz_not_silent():
+    """A malformed /metrics body (truncated by a proxy, torn mid-write)
+    must not feed zeroed signals INVISIBLY: the replica scores
+    signal-less, the failure is counted, and /healthz degrades via
+    autoscale_check until a tick parses clean."""
+    fc = FakeClock()
+    mode = {"text": "serve_queue_depth not-a-number garbage\n"}
+    scaler, router, _ = make_scaler(fc, scrape=lambda n, r: mode["text"])
+    out = scaler.tick()
+    assert out["action"] != "error"    # the turn itself completes
+    reason = autoscale_check(scaler)()
+    assert reason is not None and "unparseable" in reason
+    snap = scaler.router.metrics.registry.snapshot()
+    assert snap["autoscale_scrape_parse_failures_total"] >= 1
+    # a clean scrape clears the degradation
+    mode["text"] = _idle_text()
+    fc.advance(1.0)
+    scaler.tick()
+    assert autoscale_check(scaler)() is None
+
+
+def test_scaler_repairs_fleet_below_min():
+    fc = FakeClock()
+    cfg = AutoscalerConfig(up_cooldown_s=100.0, breach_ticks=3,
+                           min_replicas=1, max_replicas=4)
+    scaler, router, reps = make_scaler(
+        fc, cfg=cfg, scrape=lambda n, r: _idle_text())
+    reps[0].kill()
+    out = scaler.tick()   # sweep ejects the corpse; repair ignores cooldown
+    assert out["action"] == "up"
+    assert len([n for n, st in router.replica_stats().items()
+                if st["state"] == "up"]) >= 1
+
+
+def test_autoscaler_config_validation():
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscalerConfig(high_utilization=0.3, low_utilization=0.5)
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalerConfig(min_replicas=5, max_replicas=2)
+    with pytest.raises(ValueError, match="breach_ticks"):
+        AutoscalerConfig(breach_ticks=0)
+
+
+def test_scrape_signals_round_trip_from_real_replica():
+    """The in-process scrape path parses a REAL ServeMetrics exposition —
+    the same text contract the HTTP scraper reads."""
+    fc = FakeClock()
+    rep = LocalReplica(fake_engine_factory, 1, name="rt",
+                       queue_capacity=16, clock=fc, start=False)
+    router = Router([rep], clock=fc, sleep=lambda s: fc.advance(s),
+                    metrics=RouterMetrics(clock=fc))
+    scaler = Autoscaler(router, lambda v: None, clock=fc)
+    for i in range(4):
+        router.submit(np.full((4,), i, np.float32))
+    fleet = scaler.collect()
+    sig = fleet.replicas[0]
+    assert sig.queue_depth == 4.0          # scraped, not introspected
+    assert fleet.utilization == pytest.approx(4.0 / 16.0)
+    rep.step()
+    fc.advance(0.010)
+    vals = scalar_values(parse_prometheus_text(rep.metrics.prometheus()))
+    assert vals["serve_queue_depth"] == 0.0
+
+
+# ------------------------------------------------------------ the diurnal soak
+# The soak driver itself lives in dcnn_tpu/serve/soak.py — shared
+# verbatim with bench.py (BENCH_AUTOSCALE) and examples/serve_autoscale.py
+# so all three produce identical offered load and gate arithmetic.
+
+
+def test_diurnal_soak_fake_clock_gates():
+    """The ISSUE acceptance soak, entirely sleep-free: 10x peak-to-trough
+    with a preemption and a canary swap injected mid-load."""
+    report, scaler, router = run_diurnal_soak()
+    cfg = scaler.cfg
+    # -- availability + ledger gates
+    assert report["silently_dropped"] == 0
+    assert report["outstanding_after"] == 0
+    assert report["availability"] >= 0.999, report
+    # -- the fleet actually breathed: grew toward peak, shrank after
+    assert report["scale_ups"] >= 2, report
+    assert report["peak_fleet"] >= 3, report
+    assert report["scale_downs"] >= 1, report
+    assert report["final_fleet"] <= 2, report
+    # -- SLO-violation minutes bounded (soak is 4 min long)
+    assert report["slo_violation_minutes"] <= 1.0, report
+    # -- scale-up reaction within the cooldown budget
+    if report["reaction_max_s"] is not None:
+        assert report["reaction_max_s"] <= cfg.up_cooldown_s + 2.0, report
+    # the injected death was survived (PR-9 re-admission) and counted
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_replica_deaths_total"] >= 1
+    assert snap["serve_router_swaps_total"] >= 1  # the canary swap landed
+
+
+@pytest.mark.slow
+def test_diurnal_soak_real_time():
+    """Real-clock variant (threaded dispatchers, real sleeps): a compact
+    diurnal cycle through live LocalReplicas."""
+    factory_count = [0]
+
+    def factory(version):
+        factory_count[0] += 1
+        return LocalReplica(
+            fake_engine_factory, 1 if version is None else version,
+            name=f"rt{factory_count[0]}", queue_capacity=64,
+            max_wait_ms=1.0)
+
+    boot = factory(1)
+    router = Router([boot], metrics=RouterMetrics())
+    cfg = AutoscalerConfig(
+        slo_p99_ms=100.0, high_utilization=0.5, low_utilization=0.1,
+        min_replicas=1, max_replicas=4, up_cooldown_s=0.5,
+        down_cooldown_s=2.0, breach_ticks=1, idle_ticks=2,
+        drain_timeout_s=5.0)
+    scaler = Autoscaler(router, factory, config=cfg)
+    scaler.start(interval_s=0.25)
+    try:
+        rate = diurnal(800.0, 80.0, period_s=6.0)
+        samples = [np.full((4,), 3, np.float32)]
+        futs = open_loop(router, samples, rate, 6.0)
+        deadline = time.monotonic() + 10.0
+        while router.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        scaler.stop()
+    accepted = len(futs)
+    done = sum(1 for _, f in futs if f.done())
+    completed = sum(1 for _, f in futs
+                    if f.done() and f.exception() is None)
+    assert accepted - done == 0           # no orphans
+    assert completed / accepted >= 0.99
+    router.shutdown(drain=False)
+    for rep in list(router.replicas().values()):
+        try:
+            rep.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------- device-lease handoff (e2e)
+
+RTOL, ATOL = 2e-4, 2e-5  # the PR-8 reshard FP-reassociation contract
+
+
+def _elastic_bits():
+    import jax  # noqa: F401
+    from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+    from dcnn_tpu.nn import SequentialBuilder
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(48, 16)).astype(np.float32)
+    Y = one_hot(rng.integers(0, 4, 48), 4)
+
+    def model():
+        return (SequentialBuilder("leased_model").input((16,))
+                .dense(32).activation("relu").dense(4).build())
+
+    def loader():
+        return ArrayDataLoader(X, Y, batch_size=12, seed=7)
+    return model, loader
+
+
+def _make_controller_factory(model, loader, ckpt_dir, *, epochs=4):
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel.elastic import ElasticController
+
+    def make(rank, peers, sock):
+        cfg = TrainingConfig(
+            epochs=epochs, learning_rate=0.05, seed=3, snapshot_dir=None,
+            elastic=True, elastic_microbatches=2, elastic_timeout_s=15.0,
+            elastic_heartbeat_s=0.0, elastic_ckpt_steps=2,
+            elastic_min_world=1, checkpoint_dir=ckpt_dir)
+        return ElasticController(
+            model(), SGD(0.05), "softmax_crossentropy", loader(),
+            config=cfg, rank=rank, peers=peers, listen_sock=sock)
+    return make
+
+
+def _leaves(ts):
+    import jax
+    return jax.tree_util.tree_leaves(jax.device_get(ts.params))
+
+
+def test_device_lease_handoff_end_to_end(tmp_path):
+    """The acceptance handoff: serving scale-up revokes a chip from a
+    LIVE elastic training world (which shrinks via the PR-8 reshape and
+    keeps training); load recedes, the chip returns, the world re-grows
+    from the shared checkpoint root — and the final params match an
+    uninterrupted fixed-world run within the reshard tolerance."""
+    from dcnn_tpu.parallel import LeasedElasticTrainer, TrainLease
+
+    model, loader = _elastic_bits()
+
+    # --- baseline: uninterrupted fixed-world (2 hosts) run, 4 epochs
+    base_trainer = LeasedElasticTrainer(
+        _make_controller_factory(model, loader, str(tmp_path / "base")))
+    base = base_trainer.run_segment(4, target_world=2, resume=True)
+    assert all(not isinstance(r, BaseException) for r in base.values())
+    base_params = _leaves(base[0])
+
+    # --- leased run: 3 devices shared between serving (1) and train (2)
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(3, registry=reg)
+    lease = TrainLease(broker, initial=2, min_hold=1, registry=reg)
+    broker.register("serve", priority=1, held=1)
+
+    fc = FakeClock()
+    rep_factory = make_replica_factory(fc, prefix="ho")
+    boot = rep_factory(1)
+    router = Router([boot], clock=fc, sleep=lambda s: fc.advance(s),
+                    metrics=RouterMetrics(clock=fc))
+    mode = {"text": _breach_text()}
+    scaler = Autoscaler(
+        router, rep_factory,
+        config=AutoscalerConfig(up_cooldown_s=0.0, down_cooldown_s=0.0,
+                                breach_ticks=1, idle_ticks=1,
+                                min_replicas=1, max_replicas=2),
+        broker=broker, tenant="serve", clock=fc,
+        scrape=lambda n, r: mode["text"])
+
+    trainer = LeasedElasticTrainer(
+        _make_controller_factory(model, loader,
+                                 str(tmp_path / "leased")),
+        lease=lease, min_world=1)
+
+    controllers = {}
+    orig_make = trainer.make_controller
+
+    def tracking_make(rank, peers, sock):
+        ctl = orig_make(rank, peers, sock)
+        controllers[rank] = ctl
+        return ctl
+    trainer.make_controller = tracking_make
+
+    seg1 = {}
+    t1 = threading.Thread(
+        target=lambda: seg1.update(
+            trainer.run_segment(3, target_world=2, resume=True)),
+        daemon=True)
+    t1.start()
+    # let the world make real progress before the spike lands
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        ctl = controllers.get(0)
+        if ctl is not None and len(ctl.step_log) >= 2:
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("training world never made progress")
+
+    # --- traffic spike: the serving autoscaler wants a second replica
+    out = scaler.tick()
+    assert out["action"] == "blocked"          # no free chip yet
+    assert out["reason"] == "awaiting lease"   # revocation fired at train
+    granted = {}
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        fc.advance(1.0)
+        out = scaler.tick()
+        if out["action"] == "up":
+            granted.update(out)
+            break
+        time.sleep(0.02)
+    assert granted, "serving scale-up never got the revoked device"
+    assert len(router.replica_names()) == 2
+    t1.join(timeout=120)
+    assert not t1.is_alive()
+    # exactly one host was preempted; the survivor reshaped and finished
+    assert seg1[1] == "preempted"
+    assert not isinstance(seg1[0], BaseException), seg1[0]
+    assert controllers[0].world == 1
+    assert controllers[0].stats["reconfigures"] >= 1
+    assert reg.snapshot()["train_lease_preemptions_total"] == 1
+
+    # --- load recedes: serving shrinks, the chip goes back
+    mode["text"] = _idle_text()
+    fc.advance(1.0)
+    out = scaler.tick()
+    assert out["action"] == "down"
+    assert broker.free() == 1
+
+    # --- the training world RE-GROWS from the shared checkpoint root
+    seg2 = trainer.run_segment(4, target_world=2, resume=True)
+    assert trainer.segments[-1]["world"] == 2
+    assert all(not isinstance(r, BaseException) for r in seg2.values())
+    # replicated params bit-identical across the re-grown world
+    for a, b in zip(_leaves(seg2[0]), _leaves(seg2[1])):
+        np.testing.assert_array_equal(a, b)
+    # ... and match the uninterrupted fixed-world run within the PR-8
+    # reshard tolerance: the handoff cost a reshape, not the trajectory
+    for a, b in zip(base_params, _leaves(seg2[0])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+def test_preempt_raises_at_next_beat(tmp_path):
+    """Unit view of the lease-revocation hook: preempt() surfaces as
+    PreemptedError at a step boundary and the run can be resumed."""
+    from dcnn_tpu.parallel import LeasedElasticTrainer
+    from dcnn_tpu.parallel.elastic import PreemptedError  # noqa: F401
+
+    model, loader = _elastic_bits()
+    trainer = LeasedElasticTrainer(
+        _make_controller_factory(model, loader, str(tmp_path / "solo")))
+    controllers = {}
+    orig = trainer.make_controller
+
+    def tracking(rank, peers, sock):
+        ctl = orig(rank, peers, sock)
+        ctl.preempt("unit test")       # flagged before the first beat
+        controllers[rank] = ctl
+        return ctl
+    trainer.make_controller = tracking
+    res = trainer.run_segment(1, target_world=1, resume=True)
+    assert res[0] == "preempted"
+    # nothing ran, nothing saved — a later segment starts clean
+    trainer.make_controller = orig
+    res2 = trainer.run_segment(1, target_world=1, resume=True)
+    assert not isinstance(res2[0], BaseException)
+    assert len(res2) == 1
+
+
+def test_picked_victim_that_exits_normally_declines_its_chip():
+    """A victim picked for preemption whose fit() finishes some other
+    way (returns normally before the next beat, evicted, crashed) never
+    surrenders — the accepted surrender must be DECLINED back to the
+    broker, or the phantom pending count suppresses every future
+    revocation and the serving tenant stays lease-blocked forever."""
+    from dcnn_tpu.parallel import LeasedElasticTrainer, TrainLease
+
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(2, registry=reg)
+    lease = TrainLease(broker, initial=2, min_hold=1, registry=reg)
+    broker.register("serve", priority=1, held=0)
+    release = threading.Event()
+
+    class FakeCtl:
+        def __init__(self):
+            self.preempted = threading.Event()
+
+        def preempt(self, reason=""):
+            self.preempted.set()
+
+        def fit(self, epochs, resume=True):
+            release.wait(10.0)
+            return "done"          # finishes normally despite the preempt
+
+    ctls = {}
+
+    def make_controller(rank, peers, sock):
+        ctl = FakeCtl()
+        ctls[rank] = ctl
+        return ctl
+
+    trainer = LeasedElasticTrainer(make_controller, lease=lease,
+                                   min_world=1, registry=reg)
+    seg = threading.Thread(
+        target=lambda: trainer.run_segment(1, target_world=2),
+        daemon=True)
+    seg.start()
+    deadline = time.monotonic() + 5.0
+    while len(ctls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert broker.request("serve", 1) == 0   # shortfall fires revocation
+    deadline = time.monotonic() + 5.0
+    while not ctls[1].preempted.is_set() \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert ctls[1].preempted.is_set()        # highest rank was asked
+    release.set()
+    seg.join(timeout=30.0)
+    assert not seg.is_alive()
+    # nobody surrendered, so the pending count must be handed back ...
+    assert broker.revoke_pending("train") == 0
+    assert lease.held() == 2
+    # ... and the claimant's next request re-fires instead of being
+    # suppressed by the phantom pending
+    rev0 = reg.snapshot()["lease_revocations_total"]
+    assert broker.request("serve", 1) == 0
+    assert reg.snapshot()["lease_revocations_total"] == rev0 + 1
+
+
+def test_swap_completion_does_not_resurrect_draining_replica():
+    """A decommission landing while a version load is in flight owns the
+    handle: the swap's completion must not flip "draining" back to "up"
+    (new traffic would route at a replica being drained, which the drain
+    then force-kills at timeout — a healthy replica lost)."""
+    fc = FakeClock()
+    factory = make_replica_factory(fc, prefix="r")
+    reps = [factory(1) for _ in range(2)]
+    router = Router(reps, clock=fc, sleep=lambda s: fc.advance(s),
+                    metrics=RouterMetrics(clock=fc))
+    orig_swap = reps[0].swap
+
+    def racing_swap(version):
+        # decommission's drain flip lands mid-load (swap_replica's
+        # draining guard only covers the other interleaving)
+        with router._lock:
+            router._handles[reps[0].name].state = "draining"
+        return orig_swap(version)
+
+    reps[0].swap = racing_swap
+    router.swap_replica(reps[0].name, 2)
+    st = router.replica_stats()[reps[0].name]
+    assert st["state"] == "draining"    # NOT resurrected to "up"
+    assert st["version"] == 2           # the load itself succeeded
+
+
+def test_unpickable_revocation_declined_under_min_world_floor():
+    """min_world can be the stricter floor (the lease clamps acceptance
+    only by min_hold): the accepted-but-unpickable remainder must be
+    declined back, or the phantom pending suppresses every future
+    revocation and the serving tenant is lease-starved forever while
+    training idly holds a chip min_hold would permit surrendering."""
+    from dcnn_tpu.parallel import LeasedElasticTrainer, TrainLease
+    from dcnn_tpu.parallel.elastic import PreemptedError
+
+    reg = MetricsRegistry()
+    broker = DeviceLeaseBroker(4, registry=reg)
+    lease = TrainLease(broker, initial=3, min_hold=1, registry=reg)
+    broker.register("serve", priority=1, held=1)
+    release = threading.Event()
+
+    class FakeCtl:
+        def __init__(self):
+            self.preempted = threading.Event()
+
+        def preempt(self, reason=""):
+            self.preempted.set()
+
+        def fit(self, epochs, resume=True):
+            while not release.is_set():
+                if self.preempted.wait(0.005):
+                    raise PreemptedError("preempted")
+            return "done"
+
+    ctls = {}
+
+    def make_controller(rank, peers, sock):
+        ctl = FakeCtl()
+        ctls[rank] = ctl
+        return ctl
+
+    trainer = LeasedElasticTrainer(make_controller, lease=lease,
+                                   min_world=2, registry=reg)
+    seg = threading.Thread(
+        target=lambda: trainer.run_segment(1, target_world=3),
+        daemon=True)
+    seg.start()
+    deadline = time.monotonic() + 5.0
+    while len(ctls) < 3 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # serving asks for 2: only ONE rank is preemptable above min_world=2
+    assert broker.request("serve", 2) == 0
+    deadline = time.monotonic() + 5.0
+    while (broker.revoke_pending("train") != 0
+           or broker.free() != 1) and time.monotonic() < deadline:
+        time.sleep(0.005)
+    # rank 2 surrendered its chip; the undeliverable second revocation
+    # was declined — NOT left as phantom pending
+    assert lease.held() == 2
+    assert broker.revoke_pending("train") == 0
+    assert broker.free() == 1
+    # the retry collects the freed chip, and the still-short request
+    # re-fires a revocation instead of being suppressed
+    rev0 = reg.snapshot()["lease_revocations_total"]
+    assert broker.request("serve", 2) == 1
+    assert reg.snapshot()["lease_revocations_total"] == rev0 + 1
+    deadline = time.monotonic() + 5.0
+    while broker.revoke_pending("train") != 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert broker.revoke_pending("train") == 0   # declined again
+    release.set()
+    seg.join(timeout=30.0)
+    assert not seg.is_alive()
+    assert lease.held() == 2                     # min_world floor held
+
+
+def test_pick_victims_never_repicks_inflight_preemption():
+    """A second revocation arriving while a victim is still mid-exit
+    must pick a DIFFERENT rank: re-picking the first would consume the
+    revocation on an idempotent Event.set that frees no additional chip,
+    wedging the lease accounting permanently."""
+    from dcnn_tpu.parallel import LeasedElasticTrainer
+
+    class FakeCtl:
+        def __init__(self):
+            self.preempts = 0
+
+        def preempt(self, reason=""):
+            self.preempts += 1
+
+    trainer = LeasedElasticTrainer(lambda *a: None, min_world=1)
+    ctls = {r: FakeCtl() for r in range(3)}
+    trainer._live.update(ctls)
+    trainer._on_revoke(1)
+    assert ctls[2].preempts == 1           # highest rank first
+    # rank 2 is mid-exit (still registered): the next revocation must
+    # land on rank 1, not re-consume on rank 2
+    trainer._on_revoke(1)
+    assert ctls[2].preempts == 1 and ctls[1].preempts == 1
+    assert trainer._deferred_revoke == 0
+    # min_world floor counts only ranks actually staying
+    trainer._on_revoke(1)
+    assert ctls[0].preempts == 0           # floor of 1 holds
+    assert trainer._deferred_revoke == 1   # deferred, not dropped
+    # a victim that finished exiting clears its pending mark
+    with trainer._lock:
+        trainer._live.pop(2)
+        trainer._preempt_pending.discard(2)
+    assert 2 not in trainer._preempt_pending

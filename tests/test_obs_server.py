@@ -692,3 +692,92 @@ def test_export_chrome_truncation_note(tmp_path):
 
     with pytest.raises(ValueError):
         t.export_chrome(path, max_events=0)
+
+
+# ------------------------------------- render→parse round trip (PR 11)
+# parse_prometheus_text is the autoscaler's scrape client: its only
+# contract with a replica is the exposition text itself, so the inverse
+# must round-trip everything the shared renderer emits.
+
+def test_parse_round_trips_registry_exposition():
+    from dcnn_tpu.obs.exposition import (
+        parse_prometheus_text, render_histogram, scalar_values,
+    )
+
+    r = MetricsRegistry()
+    r.counter("reqs_total", "requests\nserved").inc(5)
+    r.gauge("depth", "queue depth").set(3)
+    h = r.histogram("lat_seconds", "latency")
+    for v in (1e-5, 2e-3, 0.7, 1e9):  # incl. the +Inf overflow bucket
+        h.observe(v)
+    fams = parse_prometheus_text(r.prometheus())
+    assert fams["reqs_total"]["kind"] == "counter"
+    assert fams["reqs_total"]["value"] == 5.0
+    # HELP unescaping is the exact inverse of the renderer's escaping
+    assert fams["reqs_total"]["help"] == "requests\nserved"
+    assert fams["depth"]["kind"] == "gauge" and fams["depth"]["value"] == 3.0
+    hist = fams["lat_seconds"]
+    assert hist["kind"] == "histogram"
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(1e9 + 0.7 + 2e-3 + 1e-5)
+    assert hist["buckets"][-1] == (float("inf"), 4)
+    cums = [c for _, c in hist["buckets"]]
+    assert cums == sorted(cums)
+    # render(parse(render(x))) is the identity on values: the parsed
+    # buckets/sum/count ARE render_histogram's input shape
+    again = "\n".join(render_histogram(
+        "lat_seconds", hist["buckets"], hist["sum"], hist["count"],
+        help=hist["help"]))
+    assert parse_prometheus_text(again)["lat_seconds"] == hist
+    # the flattened scalar view the autoscaler's signal extraction reads
+    flat = scalar_values(fams)
+    assert flat["reqs_total"] == 5.0 and flat["depth"] == 3.0
+    assert "lat_seconds" not in flat  # histograms are not scalars
+
+
+def test_parse_round_trips_serve_metrics_exposition():
+    from dcnn_tpu.obs.exposition import parse_prometheus_text, scalar_values
+    from dcnn_tpu.serve import ServeMetrics
+
+    fc = FakeClock()
+    m = ServeMetrics(clock=fc)
+    m.record_submit(4)
+    m.record_queue_depth(4)
+    m.record_batch(4, 8)
+    fc.advance(0.25)
+    m.record_done(0.25, 4)
+    fams = parse_prometheus_text(m.prometheus())
+    vals = scalar_values(fams)
+    # exactly the signals the autoscaler's collect() reads
+    assert vals["serve_queue_depth"] == 4.0
+    assert vals["serve_samples_completed_total"] == 4.0
+    assert "serve_latency_window_p99_ms" in vals
+    assert fams["serve_latency_seconds"]["kind"] == "histogram"
+    assert fams["serve_latency_seconds"]["count"] == \
+        fams["serve_latency_seconds"]["buckets"][-1][1]
+
+
+def test_parse_label_escapes_and_untyped_series():
+    from dcnn_tpu.obs.exposition import (
+        escape_label_value, parse_prometheus_text,
+    )
+
+    raw = 'a "quoted\\path"\nline2'
+    text = (f'weird{{path="{escape_label_value(raw)}",x="1"}} 2.5\n'
+            "no_type_series 7\n")
+    fams = parse_prometheus_text(text)
+    labels, value = fams["weird"]["samples"][0]
+    assert labels == {"path": raw, "x": "1"}
+    assert value == 2.5
+    assert fams["no_type_series"]["kind"] == "untyped"
+    assert fams["no_type_series"]["value"] == 7.0
+
+
+def test_parse_rejects_malformed_lines():
+    from dcnn_tpu.obs.exposition import parse_prometheus_text
+
+    # a scrape that half-parses must not feed a scaling decision
+    with pytest.raises(ValueError, match="line 2"):
+        parse_prometheus_text("ok 1\nbroken_series_without_value\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_prometheus_text("bad_value nope\n")

@@ -193,3 +193,80 @@ def test_cli_usage_errors():
     rc = subprocess.run([sys.executable, COMPARE, "one.json"],
                         capture_output=True, text=True, timeout=120)
     assert rc.returncode == 2
+
+
+# ------------------------------------------- autoscale.* gate keys (PR 11)
+
+def _autoscale_cap(availability=1.0, slo_min=0.2, reaction=1.0,
+                   cooldown=5.0, **extra):
+    return {"value": 100.0, "autoscale": {
+        "availability": availability,
+        "slo_violation_minutes": slo_min,
+        "scale_up_reaction_s": reaction,
+        "up_cooldown_s": cooldown, **extra}}
+
+
+def test_autoscale_keys_skip_for_pre_pr11_captures():
+    """Skips-not-lies: a history of captures without the autoscale block
+    neither gates nor fails the new keys."""
+    report = regress.compare([{"value": 100.0}, {"value": 101.0},
+                              _autoscale_cap()])
+    assert report["ok"]
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert rows["autoscale.availability"]["verdict"] \
+        == "skipped: no comparable prior capture"
+    # and a newest capture WITHOUT the block skips against one that has it
+    report = regress.compare([_autoscale_cap(), {"value": 100.0}])
+    assert report["ok"]
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert "absent from newest" in rows["autoscale.availability"]["verdict"]
+
+
+def test_autoscale_availability_regression_flagged():
+    report = regress.compare([_autoscale_cap(availability=1.0),
+                              _autoscale_cap(availability=0.97)])
+    assert "autoscale.availability" in report["regressions"]
+    # within the 1% tolerance: passes
+    report = regress.compare([_autoscale_cap(availability=1.0),
+                              _autoscale_cap(availability=0.995)])
+    assert report["ok"]
+
+
+def test_autoscale_reaction_guarded_on_cooldown_budget():
+    """A different up_cooldown_s budget is a config change, not a
+    regression — the guard refuses the comparison."""
+    slow = _autoscale_cap(reaction=12.0, cooldown=15.0)
+    fast = _autoscale_cap(reaction=3.0, cooldown=5.0)
+    report = regress.compare([fast, slow])
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert rows["autoscale.scale_up_reaction_s"]["verdict"] \
+        == "skipped: no comparable prior capture"
+    assert report["ok"]
+    # same budget: a 4x reaction blowup IS flagged
+    report = regress.compare([fast, _autoscale_cap(reaction=12.0,
+                                                   cooldown=5.0)])
+    assert "autoscale.scale_up_reaction_s" in report["regressions"]
+
+
+def test_autoscale_slo_minutes_lower_is_better():
+    report = regress.compare([_autoscale_cap(slo_min=0.2),
+                              _autoscale_cap(slo_min=0.1)])
+    assert report["ok"]   # improvement always passes
+    report = regress.compare([_autoscale_cap(slo_min=0.2),
+                              _autoscale_cap(slo_min=2.0)])
+    assert "autoscale.slo_violation_minutes" in report["regressions"]
+
+
+def test_autoscale_zero_best_window_uses_absolute_slack():
+    """A perfect capture (0.0 minutes, un-delayed reaction) in the window
+    must not flag every later legitimate nonzero forever — the relative
+    band collapses at best=0, so the absolute slack (the soak's own
+    budget) carries the verdict."""
+    perfect = _autoscale_cap(slo_min=0.0, reaction=0.0)
+    report = regress.compare([perfect,
+                              _autoscale_cap(slo_min=0.75, reaction=4.0)])
+    assert report["ok"]   # inside the budget = operating as designed
+    report = regress.compare([perfect,
+                              _autoscale_cap(slo_min=3.0, reaction=30.0)])
+    assert "autoscale.slo_violation_minutes" in report["regressions"]
+    assert "autoscale.scale_up_reaction_s" in report["regressions"]

@@ -11,8 +11,12 @@
 #   2. python -m dcnn_tpu.analysis dcnn_tpu/ — the trace-safety /
 #      concurrency / atomicity suite against the committed baseline
 #      (docs/static_analysis.md). Zero unsuppressed findings required;
-#      this covers dcnn_tpu/aot/ too (CC03 resource-lifecycle applies to
-#      its cross-process file locks — zero baseline entries).
+#      this covers dcnn_tpu/aot/ (CC03 resource-lifecycle applies to its
+#      cross-process file locks) and the autoscaler pair
+#      serve/autoscale.py + parallel/autoscale.py (CC01 guarded_by
+#      discipline on shared scaler/broker/lease state, CC02 on the
+#      control-loop poll thread and leased-segment runners) — all with
+#      zero baseline entries.
 #   3. benchmarks/compare.py --self-test — the bench regression gate's own
 #      fixture run (planted 25% drop must flag; clean history must pass).
 #
