@@ -63,12 +63,15 @@ Router, and a kill-a-replica availability sub-soak; knobs
 BENCH_SERVE_ROUTER=0 to skip, BENCH_SERVE_ROUTER_REPLICAS default 4,
 BENCH_SERVE_ROUTER_SECONDS per-phase traffic window, regression-gated
 via serving.router.* keys in dcnn_tpu/obs/regress.py), BENCH_OBS=1 to enable the unified tracer
-(dcnn_tpu/obs/) for the whole run — writes a Chrome trace_event artifact
-(BENCH_OBS_TRACE, default /tmp/dcnn_bench_trace.json; open in Perfetto:
-training step spans on the "train" track, per-chunk H2D gather/put spans
-on the transfer-thread tracks, serve spans under BENCH_SERVE=1) and
-appends a "telemetry" block (trace path, span counts, metrics-registry
-snapshot) to the JSON line (see docs/observability.md), BENCH_FEED_WORKERS
+(dcnn_tpu/obs/) for the whole run — exports the JSONL trace shard and
+merges it (python -m dcnn_tpu.obs.trace) into the Chrome trace_event
+artifact (BENCH_OBS_TRACE, default /tmp/dcnn_bench_trace.json; open in
+Perfetto: training step spans on the "train" track, per-chunk H2D
+gather/put spans on the transfer-thread tracks, serve spans under
+BENCH_SERVE=1, trace_id/span_id identity on every span) and appends a
+"telemetry" block (merged trace path + shard list, span counts,
+ring-saturation drop counts, metrics-registry snapshot) to the JSON line
+(see docs/observability.md), BENCH_FEED_WORKERS
 (default 0) to run the host side of the streaming + host-feed sections on
 a shared-memory input-worker pool (dcnn_tpu/data/workers.py — gather +
 augment + pack off the producer thread; per-worker prep spans and
@@ -1460,14 +1463,31 @@ def main() -> None:
 
     if obs_on:
         from dcnn_tpu.obs import get_tracer
+        from dcnn_tpu.obs.trace import merge_shards
 
         tracer = get_tracer()
+        tracer.process_name = "bench"
+        # sync ring-saturation accounting onto the registry BEFORE the
+        # snapshot below (the scrape surfaces do the same per request)
+        tracer.export_gauges(reg)
         trace_path = os.environ.get("BENCH_OBS_TRACE",
                                     "/tmp/dcnn_bench_trace.json")
-        tracer.export_chrome(trace_path)
+        # the capture's trace evidence is the MERGED artifact: export the
+        # JSONL shard (the per-process format distributed runs produce),
+        # then run it through the same merge path a multi-process fleet
+        # uses — trace_file stays Perfetto-loadable either way, and the
+        # shard file next to it drops into a fleet-wide merge untouched
+        shard_path = trace_path + ".shard.jsonl"
+        tracer.export_jsonl(shard_path)
+        merge_summary = merge_shards([shard_path], trace_path)
         out["telemetry"] = {
             "trace_file": trace_path,
+            "trace_shards": [shard_path],
+            "merged": {k: merge_summary[k]
+                       for k in ("events", "trace_ids",
+                                 "events_dropped_by_writers")},
             "events": len(tracer),
+            "events_dropped": tracer.dropped,
             "spans": tracer.span_counts(),
             "metrics": get_registry().snapshot(),
         }

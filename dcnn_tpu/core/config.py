@@ -116,6 +116,14 @@ class TrainingConfig:
                                       # fit() (0 = ephemeral port; -1 = off).
                                       # healthz wires the stall watchdog and
                                       # checkpoint health automatically
+    flight_dir: Optional[str] = None  # failure flight recorder root
+                                      # (obs/flight.py): degradation edges
+                                      # (healthz 503, watchdog stall,
+                                      # non-finite guard) dump atomic
+                                      # keep-K postmortem bundles here.
+                                      # Configures the process-global
+                                      # recorder; None: DCNN_FLIGHT_DIR
+                                      # env, else off
 
     @classmethod
     def load_from_env(cls) -> "TrainingConfig":
@@ -163,6 +171,8 @@ class TrainingConfig:
             aot_cache_dir=get_env("AOT_CACHE",
                                   base.aot_cache_dir or "") or None,
             metrics_port=get_env("METRICS_PORT", base.metrics_port),
+            flight_dir=get_env("DCNN_FLIGHT_DIR",
+                               base.flight_dir or "") or None,
         )
 
     def to_dict(self) -> dict:

@@ -47,10 +47,27 @@ the pieces that let the outside world see a process (docs/observability.md
   gate behind ``benchmarks/compare.py`` and bench.py's ``regressions``
   block.
 
+PR 12 made the tracer DISTRIBUTED and failures self-documenting:
+
+- every span carries ``trace_id``/``span_id``/``parent_id``;
+  ``Tracer.inject``/``Tracer.activate`` are the propagation contract
+  every framed hop uses (``parallel/comm.py`` ships the carrier as the
+  ``_trace`` meta key), so a router request or an elastic
+  reconfiguration is ONE trace across processes;
+- :mod:`~dcnn_tpu.obs.trace` — ``python -m dcnn_tpu.obs.trace`` merges
+  per-process JSONL shards into one Perfetto-loadable Chrome trace
+  (handshake-measured clock offsets) and inspects flight bundles;
+- :mod:`~dcnn_tpu.obs.flight` — :class:`FlightRecorder`: atomic keep-K
+  postmortem bundles (spans + metrics + healthz reasons + offending
+  config) dumped on degradation edges; :func:`get_flight_recorder` is
+  the process-global instance, off until ``DCNN_FLIGHT_DIR`` /
+  :func:`configure_flight`.
+
 This package is stdlib-only at import time (no jax import) — safe to
 import from any layer, including before backend selection.
 """
 
+from .flight import FlightRecorder, configure_flight, get_flight_recorder
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .server import (TelemetryServer, checkpoint_check, elastic_check,
@@ -62,4 +79,5 @@ __all__ = [
     "Tracer", "configure", "get_tracer",
     "TelemetryServer", "watchdog_check", "checkpoint_check",
     "elastic_check",
+    "FlightRecorder", "get_flight_recorder", "configure_flight",
 ]

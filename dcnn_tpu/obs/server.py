@@ -47,6 +47,8 @@ Design rules, inherited from the rest of ``obs``:
 from __future__ import annotations
 
 import json
+import os
+import socket as _socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -161,7 +163,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                self._send(200, owner.metrics_text().encode("utf-8"),
+                self._send(200, owner.metrics_body().encode("utf-8"),
                            CONTENT_TYPE)
             elif path == "/healthz":
                 code, body = owner.health()
@@ -211,6 +213,16 @@ class TelemetryServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = clock()
+        # trace identity for /snapshot: merged multi-process traces need
+        # to attribute each shard (host, pid, rank/component/name —
+        # whatever the owner sets via set_identity)
+        self._identity: Dict[str, Any] = {}
+        # flight recorder + healthz edge detection (attach_flight):
+        # handler threads race on the 200→503 transition, so the edge
+        # state is lock-guarded
+        self._flight = None
+        self._edge_lock = threading.Lock()
+        self._last_ok = True                    # dcnn: guarded_by=_edge_lock
 
     # -- wiring ------------------------------------------------------------
     def add_check(self, name: str, fn: Callable[[], Any]
@@ -219,6 +231,33 @@ class TelemetryServer:
         healthy, a reason string when degraded; raising counts as degraded.
         Returns self for chaining."""
         self._checks.append((name, fn))
+        return self
+
+    def set_identity(self, **identity: Any) -> "TelemetryServer":
+        """Name this process for merged-trace attribution: ``/snapshot``'s
+        ``process`` block carries host + pid plus whatever the owner sets
+        here (``component="router"``, ``rank=2``, ...). Also stamps the
+        tracer's ``process_name`` (JSONL shard headers) when unset."""
+        self._identity.update(identity)
+        if getattr(self.tracer, "process_name", None) is None:
+            name = identity.get("name") or identity.get("component")
+            if name is not None:
+                self.tracer.process_name = str(name)
+        return self
+
+    def attach_flight(self, recorder) -> "TelemetryServer":
+        """Wire a :class:`~dcnn_tpu.obs.flight.FlightRecorder` to this
+        surface: the ``/healthz`` 200→503 **transition** dumps a
+        ``healthz_degraded`` bundle carrying the full 503 body (reasons,
+        checks, flags), and ``/snapshot`` gains a ``flight`` block
+        listing retained bundles. Edge-triggered: a fleet that stays
+        degraded records once per degradation episode, not per scrape."""
+        self._flight = recorder
+        self.add_snapshot("flight", lambda: {
+            "dir": recorder.directory,
+            "enabled": recorder.enabled,
+            "bundles": recorder.bundles(),
+        })
         return self
 
     def add_snapshot(self, name: str, fn: Callable[[], Any]
@@ -267,10 +306,38 @@ class TelemetryServer:
             "flags": flags,
             "uptime_s": round(max(self._clock() - self._t0, 0.0), 3),
         }
+        # flight recorder on the DEGRADATION EDGE: exactly one bundle per
+        # 200→503 transition (concurrent scrapes race on the edge, so it
+        # is claimed under the lock), carrying this very body — the 503's
+        # machine-readable reasons are postmortem evidence, not just a
+        # one-shot scrape response
+        with self._edge_lock:
+            degraded_edge = self._last_ok and not ok
+            self._last_ok = ok
+        if degraded_edge and self._flight is not None:
+            self._flight.record("healthz_degraded", reasons=reasons,
+                                health=body, registry=self.registry,
+                                tracer=self.tracer)
         return (200 if ok else 503), body
 
+    def metrics_body(self) -> str:
+        """The ``/metrics`` body: refreshes the tracer's saturation
+        series (``trace_events_dropped_total`` + buffer occupancy
+        gauges) onto the registry first, so a saturated tracer is
+        visible on the scrape that would otherwise miss it."""
+        try:
+            self.tracer.export_gauges(self.registry)
+        except Exception:
+            pass  # a broken gauge refresh must not kill the scrape
+        return self.metrics_text()
+
     def snapshot(self) -> Dict[str, Any]:
-        """Body for ``/snapshot``: registry dump + newest tracer spans."""
+        """Body for ``/snapshot``: registry dump + newest tracer spans +
+        this process's trace identity (merged traces are attributable)."""
+        try:
+            self.tracer.export_gauges(self.registry)
+        except Exception:
+            pass
         events = self.tracer.events()[-self._snapshot_events:] \
             if self._snapshot_events else []
         for ev in events:  # tracer attrs may hold arbitrary objects
@@ -280,6 +347,13 @@ class TelemetryServer:
             "spans": events,
             "span_counts": self.tracer.span_counts(),
             "tracer_enabled": self.tracer.enabled,
+            "process": {
+                "host": _socket.gethostname(),
+                "pid": os.getpid(),
+                "name": getattr(self.tracer, "process_name", None),
+                "trace_events_dropped": getattr(self.tracer, "dropped", 0),
+                **self._identity,
+            },
         }
         for name, fn in self._extra_snapshot.items():
             try:

@@ -39,21 +39,62 @@ measures dispatch wall, not device compute — call sites that fence
 (transfer-engine puts, sampled pipeline stages) get device-true spans, the
 rest are annotated as dispatch spans in their name/attrs. That is the same
 honesty line the rest of the repo draws (core/fence.py).
+
+**Distributed identity (PR 12).** Every recorded span carries
+``trace_id`` / ``span_id`` / ``parent_id`` in its attrs. Parentage comes
+from a per-thread context stack: entering ``with tracer.span(...)``
+activates that span for the thread, so nested spans chain automatically;
+:meth:`Tracer.inject` snapshots the active context as a small JSON-safe
+carrier dict and :meth:`Tracer.activate` adopts a carrier received from
+another thread or process — the pair is the propagation contract every
+framed hop uses (``parallel/comm.py`` auto-injects the carrier as the
+``_trace`` meta key; receivers ``activate`` it around their handling).
+One request or one reconfiguration therefore renders as ONE trace across
+the router, its replicas, and the elastic hosts involved, and
+``python -m dcnn_tpu.obs.trace`` merges the per-process JSONL shards into
+a single Perfetto timeline. The disabled path is untouched: ``inject``
+returns ``None`` and ``activate`` returns the shared null context
+manager — context plumbing costs nothing when tracing is off (the
+< 100 ns/span bound still holds, asserted in tests).
+
+**Saturation is visible.** Ring-buffer eviction increments a drop count
+(:attr:`Tracer.dropped`) and :meth:`Tracer.export_gauges` mirrors it to
+the registry as ``trace_events_dropped_total`` plus
+``trace_buffer_events`` / ``trace_buffer_capacity`` occupancy gauges —
+the ``/metrics`` scrape path refreshes them, so saturated tracing shows
+up on the same surface everything else does (the ``tracer.truncated``
+note only ever covered export-side truncation).
 """
 
 from __future__ import annotations
 
 import gzip as _gzip
+import itertools
 import json
 import os
+import socket as _socket
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+# per-process id prefix: pid + random so ids never collide across the
+# fleet's processes (a forked child inherits it, but forked feed workers
+# replay via record_span on the parent's tracer — they mint no ids)
+_ID_PREFIX = f"{os.getpid():x}{os.urandom(3).hex()}"
+_IDS = itertools.count(1)
+
+
+def _new_id(kind: str) -> str:
+    """Process-unique id: ``<pid-hex><rand6><kind><counter-hex>``.
+    ``next()`` on itertools.count is GIL-atomic — no lock on the span
+    hot path."""
+    return f"{_ID_PREFIX}{kind}{next(_IDS):x}"
+
 
 class _NullSpan:
-    """Singleton no-op span/handle: context manager, ``set()`` sink."""
+    """Singleton no-op span/handle: context manager, ``set()`` sink,
+    ``context()`` carrier source (always ``None``)."""
 
     __slots__ = ()
 
@@ -65,6 +106,9 @@ class _NullSpan:
 
     def set(self, **attrs) -> "_NullSpan":
         return self
+
+    def context(self) -> None:
+        return None
 
 
 _NULL_SPAN = _NullSpan()
@@ -85,19 +129,47 @@ def _null_record_span(name, t0_s, t1_s, *, track=None, **attrs):
     return None
 
 
+def _null_inject():
+    return None
+
+
+def _null_activate(carrier=None):
+    # the null span IS a no-op context manager — reuse it
+    return _NULL_SPAN
+
+
 class _Span:
     """Live span: context-manager for same-thread use, explicit handle for
     cross-thread ``begin``/``end``. ``track`` pins the display row; default
-    is the recording thread's name."""
+    is the recording thread's name.
 
-    __slots__ = ("_tracer", "name", "track", "attrs", "t0")
+    Identity: ``trace_id``/``span_id`` are minted at construction
+    (``parent_id`` from the thread's active context, or an explicit
+    ``parent=`` carrier). Entering the context manager additionally
+    *activates* the span on this thread so children chain; ``begin()``
+    handles are never activated (they may end on another thread) — use
+    ``tracer.activate(handle)`` to parent work under one explicitly."""
+
+    __slots__ = ("_tracer", "name", "track", "attrs", "t0",
+                 "trace_id", "span_id", "parent_id", "_pushed")
 
     def __init__(self, tracer: "Tracer", name: str, track: Optional[str],
-                 attrs: Dict[str, Any]):
+                 attrs: Dict[str, Any], parent=None):
         self._tracer = tracer
         self.name = name
         self.track = track
         self.attrs = attrs
+        self._pushed = False
+        ctx = parent if parent is not None else tracer._current()
+        if ctx is not None and not isinstance(ctx, dict):
+            ctx = ctx.context()  # a _Span / handle was passed as parent
+        if ctx:
+            self.trace_id = ctx.get("trace_id")
+            self.parent_id = ctx.get("span_id")
+        else:
+            self.trace_id = _new_id("t")
+            self.parent_id = None
+        self.span_id = _new_id("s")
         self.t0 = tracer._clock()
 
     def set(self, **attrs) -> "_Span":
@@ -106,17 +178,61 @@ class _Span:
         self.attrs.update(attrs)
         return self
 
+    def context(self) -> Dict[str, str]:
+        """JSON-safe carrier for cross-thread/cross-process propagation —
+        what ``tracer.inject()`` returns for the active span and what
+        ``tracer.activate(...)`` accepts."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
     def __enter__(self) -> "_Span":
         # re-stamp: construction may predate entry (begin() handles are
         # stamped at begin, but `with tracer.span(...)` should measure the
         # block, not the call)
         self.t0 = self._tracer._clock()
+        self._tracer._stack().append(self)
+        self._pushed = True
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._pushed:
+            st = self._tracer._stack()
+            # pop by identity: a mismatched exit (forked generator, crash
+            # mid-push) must not unwind someone else's context
+            if st and st[-1] is self:
+                st.pop()
+            elif self in st:
+                st.remove(self)
+            self._pushed = False
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
         self._tracer._record(self)
+        return False
+
+
+class _Activation:
+    """Context manager adopting a foreign trace context (a carrier dict
+    from :meth:`Tracer.inject`, possibly received over the wire) on this
+    thread: spans created inside become its children."""
+
+    __slots__ = ("_tracer", "_ctx")
+
+    def __init__(self, tracer: "Tracer", ctx: Dict[str, Any]):
+        self._tracer = tracer
+        self._ctx = ctx
+
+    def context(self) -> Dict[str, Any]:
+        return self._ctx
+
+    def __enter__(self) -> "_Activation":
+        self._tracer._stack().append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        st = self._tracer._stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:
+            st.remove(self)
         return False
 
 
@@ -139,6 +255,18 @@ class Tracer:
         self._epoch = clock()
         self._events: deque = deque(maxlen=capacity)
         self.capacity = capacity
+        # per-thread active-context stack (trace propagation). Lazy per
+        # thread; never touched on the disabled path.
+        self._tls = threading.local()
+        # ring-buffer eviction accounting: lock-free increment on the hot
+        # path (under the GIL a lost count needs preemption mid-RMW — a
+        # saturation *signal*, not an exactness contract); export_gauges
+        # syncs the delta onto a registry counter under _sync_lock.
+        self._dropped = 0
+        self._sync_lock = threading.Lock()
+        self._dropped_synced = 0                # dcnn: guarded_by=_sync_lock
+        # identity stamped into JSONL shard headers / merge metadata
+        self.process_name: Optional[str] = None
         self.set_enabled(enabled)
 
     # -- enable/disable ----------------------------------------------------
@@ -150,17 +278,54 @@ class Tracer:
             self.end = self._end
             self.instant = self._instant
             self.record_span = self._record_span
+            self.inject = self._inject
+            self.activate = self._activate
         else:
             self.span = _null_span
             self.begin = _null_span
             self.end = _null_end
             self.instant = _null_span
             self.record_span = _null_record_span
+            self.inject = _null_inject
+            self.activate = _null_activate
+
+    # -- context propagation -----------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _current(self):
+        st = getattr(self._tls, "stack", None)
+        return st[-1] if st else None
+
+    def _inject(self) -> Optional[Dict[str, Any]]:
+        """The thread's active trace context as a JSON-safe carrier
+        (``{"trace_id", "span_id"}``), or ``None`` when no span is
+        active. Put it in a message's metadata (``parallel/comm.py``
+        does this automatically as the ``_trace`` key) and
+        :meth:`activate` it on the receiving side."""
+        top = self._current()
+        return top.context() if top is not None else None
+
+    def _activate(self, carrier=None):
+        """Adopt ``carrier`` (an :meth:`inject` dict, a live span/handle,
+        or ``None``) as this thread's active context for the ``with``
+        block. ``None`` / malformed carriers are a no-op context manager,
+        so receivers can pass ``meta.get("_trace")`` unconditionally."""
+        if carrier is None:
+            return _NULL_SPAN
+        if isinstance(carrier, (_Span, _Activation)):
+            carrier = carrier.context()
+        if not isinstance(carrier, dict) or not carrier.get("trace_id"):
+            return _NULL_SPAN
+        return _Activation(self, carrier)
 
     # -- recording (real implementations) ----------------------------------
     def _span(self, name: str, *, track: Optional[str] = None,
-              **attrs) -> _Span:
-        return _Span(self, name, track, attrs)
+              parent=None, **attrs) -> _Span:
+        return _Span(self, name, track, attrs, parent=parent)
 
     def _end(self, handle: _Span, **attrs) -> None:
         """Close a ``begin()`` handle (cross-thread safe). Ending the null
@@ -181,6 +346,8 @@ class Tracer:
         pack phases with ``perf_counter`` (CLOCK_MONOTONIC — one clock
         system-wide on Linux, so child stamps land on the parent timeline)
         and the parent replays them onto per-worker tracks."""
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
         self._events.append(
             (name, t0_s - self._epoch, max(t1_s - t0_s, 0.0),
              track if track is not None else threading.current_thread().name,
@@ -188,6 +355,13 @@ class Tracer:
 
     def _instant(self, name: str, *, track: Optional[str] = None, **attrs):
         t = self._clock()
+        top = self._current()
+        if top is not None:  # instants inherit the active trace identity
+            ctx = top.context()
+            attrs["trace_id"] = ctx.get("trace_id")
+            attrs["parent_id"] = ctx.get("span_id")
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
         self._events.append(
             (name, t - self._epoch, None,
              track if track is not None else threading.current_thread().name,
@@ -198,15 +372,56 @@ class Tracer:
         t1 = self._clock()
         track = (span.track if span.track is not None
                  else threading.current_thread().name)
+        # identity rides in attrs so the event-tuple shape (and every
+        # exporter) stays unchanged; the merge CLI correlates on these keys
+        a = span.attrs
+        a["trace_id"] = span.trace_id
+        a["span_id"] = span.span_id
+        if span.parent_id is not None:
+            a["parent_id"] = span.parent_id
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
         # one GIL-atomic append — concurrent recorders never lose or tear
         # an event, and maxlen evicts the oldest under pressure
         self._events.append(
-            (span.name, span.t0 - self._epoch, t1 - span.t0, track,
-             span.attrs))
+            (span.name, span.t0 - self._epoch, t1 - span.t0, track, a))
 
     # -- introspection -----------------------------------------------------
     def __len__(self) -> int:
         return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring buffer since construction — the
+        saturation signal ``export_gauges`` mirrors onto the registry."""
+        return self._dropped
+
+    def export_gauges(self, registry=None) -> None:
+        """Mirror ring-buffer saturation onto a registry:
+        ``trace_events_dropped_total`` (counter — synced by delta, so
+        repeated scrapes never double-count), ``trace_buffer_events``
+        occupancy and ``trace_buffer_capacity`` gauges. Called by the
+        telemetry server's ``/metrics``/``/snapshot`` paths and by the
+        bench telemetry block — a saturated tracer is visible on the
+        same surface everything else is."""
+        if registry is None:
+            from .registry import get_registry
+            registry = get_registry()
+        with self._sync_lock:
+            d = self._dropped
+            delta = d - self._dropped_synced
+            self._dropped_synced = d
+        c = registry.counter("trace_events_dropped_total",
+                             "span events evicted from the tracer ring "
+                             "buffer (saturation — raise capacity or "
+                             "flush more often)")
+        if delta > 0:
+            c.inc(delta)
+        registry.gauge("trace_buffer_events",
+                       "events currently in the tracer ring buffer").set(
+            len(self._events))
+        registry.gauge("trace_buffer_capacity",
+                       "tracer ring buffer capacity").set(self.capacity)
 
     def _events_list(self) -> list:
         """Reader-side copy of the ring buffer. ``list(deque)`` is one
@@ -240,6 +455,24 @@ class Tracer:
         return counts
 
     # -- exporters ---------------------------------------------------------
+    def shard_meta(self) -> Dict[str, Any]:
+        """The JSONL shard header: everything the merge CLI
+        (``python -m dcnn_tpu.obs.trace``) needs to place this process's
+        events on a shared timeline — the tracer epoch in its own clock
+        domain (``perf_counter`` = CLOCK_MONOTONIC on Linux: one clock
+        system-wide, so same-host shards align exactly), plus the process
+        identity merged traces are attributed to. Cross-host shards align
+        via the HELLO/ping handshake offsets (``--offset``)."""
+        return {
+            "format": "dcnn-trace-jsonl/1",
+            "epoch_s": self._epoch,
+            "host": _socket.gethostname(),
+            "pid": os.getpid(),
+            "process": self.process_name,
+            "clock": getattr(self._clock, "__name__", str(self._clock)),
+            "dropped": self._dropped,
+        }
+
     def _write_jsonl(self, evs: list, path: str, gzip: bool) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         # tmp sibling + os.replace: a crash mid-export must never leave a
@@ -250,10 +483,15 @@ class Tracer:
             (lambda p: open(p, "w"))
         try:
             with opener(tmp) as f:
+                # header line first: readers detect it by the "shard" key
+                # (events always carry "name")
+                f.write(json.dumps({"shard": self.shard_meta()}) + "\n")
                 for (n, ts, dur, track, attrs) in evs:
                     f.write(json.dumps({"name": n, "ts_s": ts, "dur_s": dur,
                                         "track": track,
-                                        "args": dict(attrs)}) + "\n")
+                                        "args": {k: _json_safe(v)
+                                                 for k, v in attrs.items()}
+                                        }) + "\n")
             os.replace(tmp, path)
         except BaseException:
             try:

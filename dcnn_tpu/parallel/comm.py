@@ -38,6 +38,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs.tracer import get_tracer
 from ..resilience import faults as _faults
 from ..resilience.retry import retry_call
 from ..utils.compression import MetaCompressor, RawCompressor
@@ -100,6 +101,15 @@ class Channel:
         layer, never to this socket."""
         m = dict(meta or {})
         m["cmd"] = cmd
+        # distributed-trace propagation (obs/tracer.py): the sender's
+        # active span context rides every frame as the optional "_trace"
+        # meta key, so a receiver can `tracer.activate(meta.get("_trace"))`
+        # and its spans join the sender's trace across the process
+        # boundary. Free when tracing is off (inject is a null function
+        # returning None); an explicit caller-provided "_trace" wins.
+        ctx = get_tracer().inject()
+        if ctx is not None and "_trace" not in m:
+            m["_trace"] = ctx
         payload = b""
         if array is not None:
             payload = _CODEC.compress_array(

@@ -180,6 +180,13 @@ class Membership:
         self._listen = listen_sock
         self._lock = threading.Lock()
         self._channels: Dict[int, Channel] = {}   # dcnn: guarded_by=_lock
+        # perf_counter-domain clock offsets of peers that dialed us,
+        # measured from their HELLO stamp in the merge-CLI convention:
+        # offset = dialer_clock - our_clock, i.e. exactly the value
+        # `--offset <dialer-shard>=<secs>` takes with OUR shard as the
+        # reference timeline. One-way, so biased by connect latency — an
+        # alignment HINT; same-host shards align exactly without it.
+        self._clock_offsets: Dict[int, float] = {}  # dcnn: guarded_by=_lock
         self._last_heard: Dict[int, float] = {}   # dcnn: guarded_by=_lock
         self._dead: Dict[int, float] = {}         # dcnn: guarded_by=_lock
         self._detections: List[Tuple[int, float]] = []  # dcnn: guarded_by=_lock
@@ -200,7 +207,10 @@ class Membership:
             p = self.peers[r]
             ch = connect(p.host, p.port,
                          timeout=max(deadline - self._clock(), 1.0))
-            ch.send("HELLO", {"rank": self.rank})
+            # t_mono: the acceptor estimates our perf_counter offset for
+            # trace-shard alignment (python -m dcnn_tpu.obs.trace)
+            ch.send("HELLO", {"rank": self.rank,
+                              "t_mono": time.perf_counter()})
             self._register(r, ch)
         expected = {r for r in self.peers if r > self.rank}
         if expected and self._listen is None:
@@ -224,6 +234,13 @@ class Membership:
             if cmd != "HELLO" or meta.get("rank") not in expected:
                 ch.close()
                 continue
+            if "t_mono" in meta:
+                # dialer_clock - our_clock (the dialer stamped t_mono
+                # just before we read our clock, so the difference IS
+                # its offset onto our timeline, up to connect latency)
+                off = float(meta["t_mono"]) - time.perf_counter()
+                with self._lock:
+                    self._clock_offsets[meta["rank"]] = off
             self._register(meta["rank"], ch)
             expected.discard(meta["rank"])
         if self._listen is not None:
@@ -299,6 +316,15 @@ class Membership:
         with self._lock:
             out, self._detections = self._detections, []
         return out
+
+    def clock_offsets(self) -> Dict[int, float]:
+        """Per-peer perf_counter offsets estimated from HELLO stamps
+        (peers that dialed us only), in the merge-CLI convention —
+        ``offset = peer_clock - our_clock``, passed verbatim as
+        ``--offset <peer-shard>=<value>`` with our shard as the
+        reference timeline on cross-host fleets."""
+        with self._lock:
+            return dict(self._clock_offsets)
 
     # -- frames ------------------------------------------------------------
     def send(self, rank: int, cmd: str, meta: Dict[str, Any],
@@ -490,6 +516,11 @@ class ElasticController:
         self._flat_size = 0
         self._init_snapshot = None
         self._last_saved_step = -1
+        # per-generation trace context: the leader's elastic.reconfigure
+        # span, adopted by every survivor via the RECONF frame's _trace
+        # carrier — a reconfiguration (and the steps of the generation it
+        # establishes) renders as ONE cross-host timeline
+        self._gen_ctx = None
         # set by preempt() (any thread); checked at every step beat
         self._preempt = threading.Event()
         self._preempt_reason = "preempted"
@@ -530,6 +561,12 @@ class ElasticController:
         microbatch count re-jits the grad step (cached per count), and
         the flat gradient codec is re-anchored on the live state's
         treedef."""
+        with get_tracer().span("elastic.rebuild", track="elastic",
+                               rank=self.rank, gen=self.gen,
+                               world=self.world):
+            self._build_inner(ts)
+
+    def _build_inner(self, ts: TrainState) -> None:
         lo, hi = self._local_span()
         a = hi - lo
         if a not in self._grad_steps:
@@ -681,8 +718,9 @@ class ElasticController:
                 shard = None
                 x, y = self.loader.rows(sel)
             step_rng = jax.random.fold_in(epoch_rng, s)
-            with tracer.span("elastic.step", track="elastic", gen=self.gen,
-                             step=gs):
+            with tracer.span("elastic.step", track="elastic",
+                             parent=self._gen_ctx, rank=self.rank,
+                             gen=self.gen, step=gs):
                 grad_sum, state_new, loss_sum = gstep(
                     ts.params, ts.state, jnp.asarray(x), jnp.asarray(y),
                     step_rng, jnp.asarray(lo, jnp.int32))
@@ -867,8 +905,12 @@ class ElasticController:
         survivor restored the SAME commit — a mismatch means the hosts do
         not share a checkpoint root, which can only diverge the replicas."""
         t0 = self._clock()
-        restored = self.checkpoints.restore_latest(seed=self.cfg.seed) \
-            if self.checkpoints is not None else None
+        with get_tracer().span("elastic.restore", track="elastic",
+                               rank=self.rank, gen=self.gen) as rs:
+            restored = self.checkpoints.restore_latest(seed=self.cfg.seed) \
+                if self.checkpoints is not None else None
+            rs.set(found=restored is not None,
+                   ckpt_step=getattr(restored, "step", None))
         if restored is None:
             snap = self._init_snapshot
             ts = TrainState(snap["params"], snap["state"],
@@ -908,14 +950,26 @@ class ElasticController:
         self.reconfiguring = True
         self._reg.gauge("elastic_reconfiguring",
                         "1 while a reconfiguration is in flight").set(1)
+        tracer = get_tracer()
+        # the reconfiguration's root span: if this host ends up leading,
+        # its context rides the RECONF broadcast (comm's _trace carrier)
+        # and every survivor's restore/rebuild joins this trace; if it
+        # ends up following, _join_reconf adopts the leader's instead
+        rspan = tracer.begin("elastic.reconfigure", track="elastic",
+                             rank=self.rank, gen_from=self.gen)
         try:
             while True:
                 try:
-                    out = self._reconfigure_once(sig, gs)
+                    with tracer.activate(rspan):
+                        out = self._reconfigure_once(sig, gs)
                     break
                 except (PeerLostError, _ReconfigureSignal) as again:
                     sig = again
             ts, epoch, step, new_gs = out
+            if self._gen_ctx is None or self.rank == self.survivors[0]:
+                # leader (or solo survivor): the generation's steps
+                # parent under this reconfigure span
+                self._gen_ctx = rspan.context()
             for _rank, age in self.membership.pop_detections():
                 self.stats["detection_s"].append(age)
                 self._reg.histogram(
@@ -938,6 +992,7 @@ class ElasticController:
                 ).observe(self.stats["restore_s"][-1])
             return ts, epoch, step, new_gs
         finally:
+            tracer.end(rspan, gen=self.gen, world=self.world)
             self.reconfiguring = False
             self._reg.gauge("elastic_reconfiguring",
                             "1 while a reconfiguration is in flight").set(0)
@@ -998,7 +1053,10 @@ class ElasticController:
     def _join_reconf(self, meta: Dict[str, Any]
                      ) -> Tuple[TrainState, int, int, int]:
         """Adopt an established generation as a follower: restore the
-        commit the leader named, ack, rebuild for the new world."""
+        commit the leader named, ack, rebuild for the new world — all
+        under the leader's reconfiguration trace (the RECONF frame's
+        ``_trace`` carrier), so the whole generation change is one
+        cross-host timeline."""
         survivors = list(meta["survivors"])
         if self.rank not in survivors:
             raise EvictedError(
@@ -1006,15 +1064,20 @@ class ElasticController:
                 f"{meta['gen']} (survivors {survivors}) — the quorum "
                 f"timed this host out; exiting")
         self.gen = int(meta["gen"])
-        ts, epoch, step, new_gs, _ = self._restore(
-            expect_step=meta["ckpt_step"])
-        self.lr = float(meta["lr"])
-        self.membership.send(meta["rank"], "RECONF_ACK",
-                             {"gen": self.gen})
-        self.survivors = survivors
-        self.world = len(survivors)
-        self.position = survivors.index(self.rank)
-        self._build(ts)
+        tracer = get_tracer()
+        ctx = meta.get("_trace")
+        if ctx is not None:
+            self._gen_ctx = ctx
+        with tracer.activate(ctx):
+            ts, epoch, step, new_gs, _ = self._restore(
+                expect_step=meta["ckpt_step"])
+            self.lr = float(meta["lr"])
+            self.membership.send(meta["rank"], "RECONF_ACK",
+                                 {"gen": self.gen})
+            self.survivors = survivors
+            self.world = len(survivors)
+            self.position = survivors.index(self.rank)
+            self._build(ts)
         return ts, epoch, step, new_gs
 
 
@@ -1050,8 +1113,11 @@ def elastic_fit(trainer, ts, train_loader, val_loader=None,
     telemetry = None
     try:
         if cfg.metrics_port >= 0:
-            from ..obs import TelemetryServer, elastic_check
+            from ..obs import (TelemetryServer, elastic_check,
+                               get_flight_recorder)
             telemetry = TelemetryServer(port=cfg.metrics_port)
+            telemetry.set_identity(component="elastic", rank=rank)
+            telemetry.attach_flight(get_flight_recorder())
             telemetry.add_check("elastic", elastic_check(controller))
             if controller.checkpoints is not None:
                 from ..obs import checkpoint_check

@@ -404,7 +404,7 @@ class Autoscaler:
                  = _default_scrape,
                  registry=None,
                  clock: Callable[[], float] = time.monotonic,
-                 name: str = "autoscaler"):
+                 name: str = "autoscaler", flight=None):
         self.router = router
         self.factory = factory
         self.cfg = config if config is not None else AutoscalerConfig()
@@ -413,6 +413,7 @@ class Autoscaler:
         self.version_fn = version_fn
         self.scrape = scrape
         self.name = name
+        self._flight = flight  # None: process-global flight recorder
         self._clock = clock
         self._reg = registry if registry is not None \
             else router.metrics.registry
@@ -423,6 +424,7 @@ class Autoscaler:
         self._idle_run = 0                    # dcnn: guarded_by=_lock
         self._breach_since: Optional[float] = None  # dcnn: guarded_by=_lock
         self._breach_reacted = False          # dcnn: guarded_by=_lock
+        self._slo_breached = False            # dcnn: guarded_by=_lock
         self._last_up: Optional[float] = None  # dcnn: guarded_by=_lock
         self._last_down: Optional[float] = None  # dcnn: guarded_by=_lock
         self._last_tick: Optional[float] = None  # dcnn: guarded_by=_lock
@@ -627,6 +629,33 @@ class Autoscaler:
         if breach and dt > 0:
             self._slo_violation_s.inc(dt)
         self._breach_gauge.set(1 if breach else 0)
+        # flight recorder at the SLO-breach EDGE (first breaching tick of
+        # an episode — `breach`, not `pressure`: pre-emptive growth on
+        # utilization is the loop working, not a violation). record()
+        # never raises, honoring tick()'s never-raise contract.
+        with self._lock:
+            breach_edge = breach and not self._slo_breached
+            self._slo_breached = breach
+        if breach_edge:
+            from ..obs.flight import resolve_flight_recorder
+            resolve_flight_recorder(self._flight).record(
+                "autoscale_slo_breach",
+                reasons=[r for r, hit in (
+                    (f"p99 {fleet.p99_ms}ms > slo {cfg.slo_p99_ms}ms",
+                     breach_p99),
+                    (f"shed fraction {fleet.shed_fraction:.4f} > "
+                     f"{cfg.max_shed_fraction:g}", breach_shed),
+                    (f"routable {fleet.routable} < min_replicas "
+                     f"{cfg.min_replicas}", breach_none)) if hit],
+                registry=self._reg,
+                config={"slo_p99_ms": cfg.slo_p99_ms,
+                        "max_shed_fraction": cfg.max_shed_fraction,
+                        "min_replicas": cfg.min_replicas,
+                        "max_replicas": cfg.max_replicas},
+                extra={"routable": fleet.routable,
+                       "p99_ms": fleet.p99_ms,
+                       "shed_fraction": fleet.shed_fraction,
+                       "utilization": fleet.utilization})
         out: Dict[str, Any] = {
             "routable": fleet.routable,
             "utilization": round(fleet.utilization, 4),

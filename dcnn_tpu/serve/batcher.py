@@ -202,6 +202,11 @@ class DynamicBatcher:
         srv = TelemetryServer(registry=self.metrics.registry,
                               metrics_text=self.metrics.prometheus,
                               host=host, port=port)
+        # merged-trace attribution + flight bundles on this replica's
+        # own scrape surface (the recorder is a no-op until enabled)
+        from ..obs.flight import get_flight_recorder
+        srv.set_identity(component="replica", name=self.engine.name)
+        srv.attach_flight(get_flight_recorder())
         # mirror the engine's per-sample cost gauges, HBM watermark, and
         # per-bucket compile accounting onto THIS scrape registry:
         # ServeMetrics' default registry is private, and the startup
@@ -280,8 +285,25 @@ class DynamicBatcher:
             x = (batch[0].x if len(batch) == 1
                  else np.concatenate([r.x for r in batch]))
             rows = x.shape[0]
-            with tracer.span("serve.dispatch", track="serve",
-                             requests=len(batch), rows=rows) as dspan:
+            # distributed-trace parentage: the dispatch covers every
+            # request in the batch, and a batch may mix traces. A
+            # single-trace batch parents the dispatch/infer spans under
+            # that trace (the cross-process correlation the router soak
+            # asserts); a mixed batch records the trace-id list instead
+            # — one span cannot honestly claim several parents. Guarded
+            # on `enabled` so the disabled dispatch path does zero
+            # context work (the null spans carry no contexts anyway).
+            parent, extra = None, {}
+            if tracer.enabled:
+                ctxs = [c for c in (r.span.context() if r.span is not None
+                                    else None for r in batch) if c]
+                tids = {c["trace_id"] for c in ctxs}
+                parent = ctxs[0] if len(tids) == 1 else None
+                if len(tids) > 1:
+                    extra = {"trace_ids": sorted(tids)[:8]}
+            with tracer.span("serve.dispatch", track="serve", parent=parent,
+                             requests=len(batch), rows=rows,
+                             **extra) as dspan:
                 padded, _ = self.engine.pad_to_bucket(x)
                 dspan.set(bucket=int(padded.shape[0]))
                 # np.asarray materializes on host — a hard fence, so

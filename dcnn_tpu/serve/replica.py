@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import get_tracer
 from ..parallel.comm import Channel, ChannelClosed, connect, listen
 from ..resilience import faults as _faults
 from ..resilience.faults import InjectedCrash
@@ -422,13 +423,26 @@ class ReplicaServer:
         if cmd == "infer":
             rid = meta["id"]
             try:
-                fut = self.replica.submit(payload)
+                # adopt the router's trace context for this hop: the
+                # batcher's serve.queue span (begun inside submit) — and
+                # through it the dispatch/infer spans — join the
+                # router-side request trace across the process boundary
+                with get_tracer().activate(meta.get("_trace")):
+                    fut = self.replica.submit(payload)
             except Exception as e:
                 self._send(ch, "error", self._err_meta(rid, e))
                 return
             fut.add_done_callback(lambda f: self._reply(ch, rid, f))
         elif cmd == "ping":
-            self._send(ch, "pong", self._pong_meta())
+            # echo the client's monotonic stamp + our own: the client
+            # estimates the cross-process clock offset the trace-merge
+            # CLI aligns shards with (NTP-style midpoint; exact on one
+            # host where perf_counter is CLOCK_MONOTONIC system-wide)
+            pong = self._pong_meta()
+            if "t_mono" in meta:
+                pong["t_echo"] = meta["t_mono"]
+                pong["t_srv"] = time.perf_counter()
+            self._send(ch, "pong", pong)
         elif cmd == "swap":
             # swap drains — seconds of wall — and must not block this
             # reader (pings keep flowing or the client calls us dead)
@@ -539,6 +553,11 @@ class TcpReplica:
         self._remote: Dict[str, Any] = {          # dcnn: guarded_by=_lock
             "health": None, "version": None, "queue_depth": 0,
             "queue_capacity": queue_capacity_hint, "input_shape": None}
+        # perf_counter-domain offset to the server process, estimated
+        # from the ping/pong handshake (NTP midpoint) — the per-shard
+        # alignment hint for `python -m dcnn_tpu.obs.trace merge`
+        self.clock_offset_s: Optional[float] = None  # dcnn: guarded_by=_lock
+        self.rtt_s: Optional[float] = None        # dcnn: guarded_by=_lock
         self._pong = threading.Event()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True,
@@ -577,11 +596,20 @@ class TcpReplica:
         elif cmd == "error":
             self._on_error(meta)
         elif cmd == "pong":
+            te, ts_srv = meta.get("t_echo"), meta.get("t_srv")
             with self._lock:
                 self._remote.update(
                     {k: meta.get(k, self._remote.get(k))
                      for k in ("health", "version", "queue_depth",
                                "queue_capacity", "input_shape")})
+                if te is not None and ts_srv is not None:
+                    # handshake clock alignment: offset such that
+                    # server_perf_counter ≈ client_perf_counter + offset
+                    now = time.perf_counter()
+                    rtt = max(now - float(te), 0.0)
+                    self.rtt_s = rtt
+                    self.clock_offset_s = float(ts_srv) - (float(te)
+                                                           + rtt / 2.0)
             self._pong.set()
         elif cmd == "swapped":
             with self._lock:
@@ -712,7 +740,7 @@ class TcpReplica:
             if self._last_ping <= self._last_heard:
                 self._last_ping = self._clock()
         try:
-            self._send("ping", {})
+            self._send("ping", {"t_mono": time.perf_counter()})
         except ReplicaDeadError:
             pass  # already marked dead with the reason
 

@@ -58,6 +58,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import get_tracer
 from ..resilience import faults as _faults
 from ..resilience.retry import retry_call
 from .batcher import DrainingError, QueueFullError
@@ -110,7 +111,7 @@ class _Handle:
 
 class _Request:
     __slots__ = ("x", "n", "priority", "future", "t_submit", "attempts",
-                 "tried")
+                 "tried", "span")
 
     def __init__(self, x, n, priority, t_submit):
         self.x, self.n, self.priority = x, n, priority
@@ -118,6 +119,10 @@ class _Request:
         self.t_submit = t_submit
         self.attempts = 0            # re-admissions consumed
         self.tried: set = set()      # replica names tried THIS admission
+        # root distributed-trace span (admit → resolve): every replica
+        # hop runs under its context, so one request is ONE trace across
+        # the fleet (null handle when tracing is off)
+        self.span = None
 
 
 class Router:
@@ -136,7 +141,7 @@ class Router:
                  metrics: Optional[RouterMetrics] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 name: str = "router"):
+                 name: str = "router", flight=None):
         self.name = name
         self.shares = dict(DEFAULT_SHARES if shares is None else shares)
         unknown = set(self.shares) - set(PRIORITIES)
@@ -162,6 +167,10 @@ class Router:
         self._closing = False                   # dcnn: guarded_by=_lock
         self._seq = 0                           # dcnn: guarded_by=_lock
         self._telemetry = None
+        # failure flight recorder (obs/flight.py): replica death/eviction
+        # dumps a postmortem bundle. None = the process-global recorder
+        # (disabled unless DCNN_FLIGHT_DIR / configure_flight enabled it).
+        self._flight = flight
         for item in replicas:
             if isinstance(item, tuple):
                 self.add_replica(item[1], name=item[0])
@@ -315,21 +324,34 @@ class Router:
             self._ledger.add(req)
             self._outstanding += n
             self.metrics.outstanding_rows.set(self._outstanding)
+        tracer = get_tracer()
+        # ONE root span per admitted request, admit → resolve. Dispatch
+        # runs under its context so the whole chain — replica submit, the
+        # batcher's queue/dispatch/infer spans, the TCP infer frame's
+        # _trace carrier — shares this trace_id across threads AND
+        # processes. Ended exactly once, where the ledger retires.
+        req.span = tracer.begin("serve.request", track="router",
+                                priority=priority, n=n)
         try:
-            self._first_dispatch(req)
+            with tracer.activate(req.span):
+                self._first_dispatch(req)
         except RouterShedError:
             # aggregate admission passed but every replica's own queue
             # shed: undo acceptance — the caller sees one coherent shed,
             # counted ONLY as shed (it was never truly admitted)
             if self._retire(req):
                 self.metrics.record_shed(priority, req.n)
+                tracer.end(req.span, outcome="shed")
             raise
-        except BaseException:
+        except BaseException as e:
             # anything non-typed out of the dispatch path (a malformed
             # request the replica's own validation rejects, an injected
             # routing fault) is the CALLER's error: un-admit so the
-            # ledger cannot leak the request, then propagate
-            self._retire(req)
+            # ledger cannot leak the request, then propagate. The span
+            # ends only when THIS path retired the request — a typed
+            # resolve inside dispatch already ended it.
+            if self._retire(req):
+                tracer.end(req.span, outcome=type(e).__name__)
             raise
         # counted as admitted only once placement is secured (or the
         # future already failed typed — still an accepted request), so a
@@ -443,7 +465,11 @@ class Router:
                 others = any(h.state == "up" and h.name != failed
                              for h in self._handles.values())
             req.tried = {failed} if others else set()
-            self._try_replica(req)
+            # re-dispatch stays inside the request's root trace (this
+            # runs on whatever thread settled the failed future — the
+            # submitter's context is long gone)
+            with get_tracer().activate(req.span):
+                self._try_replica(req)
 
         try:
             # NOTE: this runs on whatever thread settled the failed future
@@ -517,7 +543,8 @@ class Router:
             # resolved while in flight — a drain timeout (already
             # retired) or a caller cancel (not): retire here so a
             # cancelled-then-failed request cannot leak the ledger
-            self._retire(req)
+            if self._retire(req):
+                get_tracer().end(req.span, outcome="cancelled")
             return
         if closing or req.attempts >= self.max_readmits:
             self._resolve_exc(req, exc if isinstance(exc, ReplicaError)
@@ -545,6 +572,8 @@ class Router:
                     latency_s: float) -> None:
         if not self._retire(req):
             return
+        get_tracer().end(req.span, outcome="ok",
+                         latency_ms=round(latency_s * 1e3, 3))
         try:
             req.future.set_result(result)
             self.metrics.record_done(req.priority, latency_s, req.n)
@@ -554,6 +583,7 @@ class Router:
     def _resolve_exc(self, req: _Request, exc: BaseException) -> None:
         if not self._retire(req):
             return
+        get_tracer().end(req.span, outcome=type(exc).__name__)
         try:
             req.future.set_exception(exc)
             self.metrics.record_failed(req.priority, req.n)
@@ -561,6 +591,10 @@ class Router:
             pass
 
     # -- liveness ----------------------------------------------------------
+    def _flight_recorder(self):
+        from ..obs.flight import resolve_flight_recorder
+        return resolve_flight_recorder(self._flight)
+
     def _note_dead(self, h: _Handle, reason: str) -> None:
         with self._lock:
             if h.state == "dead":
@@ -568,6 +602,16 @@ class Router:
             h.state = "dead"
             self._update_gauges_locked()
         self.metrics.record_replica_death()
+        # postmortem evidence AT the death edge (once per ejection — the
+        # guard above makes this edge-triggered): recent spans hold the
+        # victim's last requests, the registry snapshot the fleet state.
+        # record() never raises and is a no-op while the recorder is off.
+        self._flight_recorder().record(
+            "replica_death",
+            reasons=[f"replica {h.name}: {reason}"],
+            registry=self.metrics.registry,
+            extra={"replica": h.name, "router": self.name,
+                   "fleet": self.replica_stats()})
 
     def check_replicas(self) -> Dict[str, Any]:
         """One liveness sweep — the router's heartbeat, called by the
@@ -773,6 +817,8 @@ class Router:
         srv = TelemetryServer(registry=self.metrics.registry,
                               metrics_text=self.metrics.prometheus,
                               host=host, port=port)
+        srv.set_identity(component="router", name=self.name)
+        srv.attach_flight(self._flight_recorder())
         srv.add_check("router", _check)
         srv.add_snapshot("router", self.metrics.snapshot)
         srv.add_snapshot("replicas", self.replica_stats)

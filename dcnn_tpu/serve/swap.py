@@ -142,10 +142,12 @@ class ModelVersionManager:
                  max_error_delta: float = 0.02,
                  max_latency_ratio: float = 3.0,
                  current_version: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 flight=None):
         if not 0.0 < canary_fraction <= 1.0:
             raise ValueError(f"canary_fraction must be in (0, 1], "
                              f"got {canary_fraction}")
+        self._flight = flight  # None: process-global flight recorder
         self.router = router
         self.factory = factory
         self.canary_fraction = canary_fraction
@@ -401,6 +403,7 @@ class ModelVersionManager:
                 self.router.set_canary(name, False)
         with self._lock:
             self._quarantined.add(version)
+            quarantined = sorted(map(repr, self._quarantined))
             self._state = "idle"
             self._target = None
             self._canaries = []
@@ -408,6 +411,16 @@ class ModelVersionManager:
             self._base = {}
         self.router.metrics.record_rollback()
         self._export_gauges()
+        # postmortem bundle at the rollback edge: the judged deltas in
+        # `reason`, the quarantined version, and the spans/metrics of the
+        # canary window (no-op while the flight recorder is disabled)
+        from ..obs.flight import resolve_flight_recorder
+        resolve_flight_recorder(self._flight).record(
+            "canary_rollback", reasons=[reason],
+            registry=self.router.metrics.registry,
+            config={"version": version, "canaries": canaries,
+                    "pre_versions": {k: repr(v) for k, v in pre.items()},
+                    "quarantined": quarantined})
         return {"action": "rolled_back", "version": version,
                 "canaries": canaries, "reason": reason}
 

@@ -263,6 +263,15 @@ class Trainer:
         self.scheduler = scheduler
         self.profiler = (LayerProfiler(self.config.profiler)
                          if self.config.profiler != ProfilerType.NONE else None)
+        # failure flight recorder (obs/flight.py): flight_dir enables the
+        # PROCESS-GLOBAL recorder so every trigger site this trainer
+        # touches — the non-finite guard, the stall watchdog, the
+        # telemetry server's healthz 503 edge — dumps postmortem bundles
+        # there without per-site plumbing (same semantics as the
+        # DCNN_FLIGHT_DIR env var, applied at construction)
+        if self.config.flight_dir:
+            from ..obs.flight import configure_flight
+            configure_flight(self.config.flight_dir)
         # non-finite step guard (resilience/guards.py): "off" keeps the
         # exact pre-guard graph; any policy compiles the guarded step that
         # returns (and neutralizes) the bad flag in-graph
@@ -625,9 +634,11 @@ class Trainer:
                 # fit. Inside the try: a failed bind (port in use) must
                 # still stop the watchdog below
                 from ..obs import (TelemetryServer, checkpoint_check,
-                                   watchdog_check)
+                                   get_flight_recorder, watchdog_check)
                 srv = TelemetryServer(registry=reg, tracer=tracer,
                                       port=cfg.metrics_port)
+                srv.set_identity(component="trainer")
+                srv.attach_flight(get_flight_recorder())
                 if self.watchdog is not None:
                     srv.add_check("watchdog",
                                   watchdog_check(self.watchdog))

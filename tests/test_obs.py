@@ -160,6 +160,15 @@ def test_registry_reset_keeps_instrument_identity():
 
 # ----------------------------------------------------------------- tracer
 
+_ID_KEYS = ("trace_id", "span_id", "parent_id")
+
+
+def _user_args(ev):
+    """Span args minus the distributed-tracing identity keys (PR 12:
+    every recorded span carries trace_id/span_id[/parent_id])."""
+    return {k: v for k, v in ev["args"].items() if k not in _ID_KEYS}
+
+
 def test_tracer_fake_clock_exact():
     fc = FakeClock()
     t = Tracer(clock=fc, enabled=True)
@@ -172,7 +181,13 @@ def test_tracer_fake_clock_exact():
     assert [e["name"] for e in evs] == ["a.work", "a.work"]
     assert evs[0]["ts_s"] == 0.0 and evs[0]["dur_s"] == 0.25
     assert evs[1]["ts_s"] == 1.25 and evs[1]["dur_s"] == 0.5
-    assert evs[0]["track"] == "x" and evs[0]["args"] == {"k": 1}
+    assert evs[0]["track"] == "x"
+    # user attrs intact; every span now also carries its trace identity
+    assert _user_args(evs[0]) == {"k": 1}
+    assert evs[0]["args"]["trace_id"] and evs[0]["args"]["span_id"]
+    # the two spans are separate roots: distinct traces, no parent
+    assert evs[0]["args"]["trace_id"] != evs[1]["args"]["trace_id"]
+    assert "parent_id" not in evs[0]["args"]
 
 
 def test_tracer_record_span_replay():
@@ -209,7 +224,7 @@ def test_tracer_cross_thread_begin_end():
     assert ev["name"] == "q.wait" and ev["dur_s"] == 0.125
     # the event lands on the span's OWN track, not the closing thread's
     assert ev["track"] == "queue"
-    assert ev["args"] == {"req": 7, "dispatched": True}
+    assert _user_args(ev) == {"req": 7, "dispatched": True}
 
 
 def test_tracer_concurrent_spans_none_lost_or_duplicated():
@@ -290,8 +305,11 @@ def test_jsonl_export_round_trip(tmp_path):
     path = t.export_jsonl(str(tmp_path / "t.jsonl"))
     with open(path) as f:
         lines = [json.loads(l) for l in f]
-    assert [l["args"]["i"] for l in lines] == list(range(5))
-    assert all(l["dur_s"] >= 0 for l in lines)
+    # line 1 is the shard header (merge-CLI metadata); events follow
+    assert "shard" in lines[0] and lines[0]["shard"]["pid"] == os.getpid()
+    events = lines[1:]
+    assert [l["args"]["i"] for l in events] == list(range(5))
+    assert all(l["dur_s"] >= 0 for l in events)
 
 
 def test_disabled_tracer_is_noop_and_cheap():

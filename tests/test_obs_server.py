@@ -180,7 +180,9 @@ def test_server_end_to_end_ephemeral_port():
         assert s["metrics"]["pings_total"] == 2
         assert s["span_counts"] == {"unit.op": 1}
         assert s["spans"][0]["name"] == "unit.op"
-        assert s["spans"][0]["args"] == {"k": 1}
+        args = s["spans"][0]["args"]
+        assert args["k"] == 1
+        assert args["trace_id"] and args["span_id"]  # PR 12 identity
 
         code, _, body = _get(srv.url + "/nope")
         assert code == 404 and "routes" in json.loads(body)
@@ -574,6 +576,18 @@ def test_sample_hbm_watermark_and_latch(monkeypatch):
 
 # ------------------------------------------------------ tracer satellites
 
+def _jsonl_events(lines):
+    """Parsed JSONL events, skipping the shard-header line PR 12's merge
+    CLI reads (detected by its "shard" key — events always carry "name")."""
+    out = []
+    for line in lines:
+        obj = json.loads(line)
+        if "shard" in obj and "name" not in obj:
+            continue
+        out.append(obj)
+    return out
+
+
 def test_flush_jsonl_plain_and_gzip(tmp_path):
     fc = FakeClock()
     t = Tracer(clock=fc, enabled=True)
@@ -587,9 +601,9 @@ def test_flush_jsonl_plain_and_gzip(tmp_path):
     t.flush_jsonl(gz, gzip=True)  # flush writes then clears
     assert len(t) == 0
     with open(plain) as f:
-        plain_evs = [json.loads(l) for l in f]
+        plain_evs = _jsonl_events(f)
     with gzip.open(gz, "rt") as f:
-        gz_evs = [json.loads(l) for l in f]
+        gz_evs = _jsonl_events(f)
     assert plain_evs == gz_evs
     assert [e["args"]["i"] for e in gz_evs] == [0, 1, 2, 3]
     assert all(e["dur_s"] == 0.5 for e in gz_evs)
@@ -617,11 +631,11 @@ def test_flush_jsonl_concurrent_events_survive_and_epoch_persists(
     monkeypatch.setattr(t, "_write_jsonl", orig)
     assert [e["name"] for e in t.events()] == ["b"]  # survived the flush
     with open(p1) as f:
-        assert [json.loads(l)["name"] for l in f] == ["a"]
+        assert [e["name"] for e in _jsonl_events(f)] == ["a"]
     p2 = str(tmp_path / "f2.jsonl")
     t.flush_jsonl(p2)
     with open(p2) as f:
-        evs2 = [json.loads(l) for l in f]
+        evs2 = _jsonl_events(f)
     assert [e["name"] for e in evs2] == ["b"]
     assert evs2[0]["ts_s"] == 1.0  # same epoch as before the first flush
     assert len(t) == 0
@@ -649,7 +663,7 @@ def test_flush_jsonl_saturated_ring_never_overpops(tmp_path, monkeypatch):
     p = str(tmp_path / "sat.jsonl")
     t.flush_jsonl(p)
     with open(p) as f:
-        assert [json.loads(l)["name"] for l in f] == ["old"] * 4
+        assert [e["name"] for e in _jsonl_events(f)] == ["old"] * 4
     # both never-exported events survive; all exported ones are gone
     assert [(e["name"], e["args"]["j"]) for e in t.events()] == [
         ("new", 0), ("new", 1)]

@@ -1,0 +1,1011 @@
+"""Cross-process distributed tracing + flight recorder (ISSUE 12).
+
+Coverage map:
+
+- **Trace identity / propagation units**: span id minting, nesting,
+  ``inject``/``activate`` carriers, begin/end cross-thread identity, the
+  disabled path staying a no-op (the <100 ns bound itself lives in
+  tests/test_obs.py).
+- **Wire propagation**: ``Channel.send`` auto-injects ``_trace`` over a
+  real socket pair; the serving chain shares one trace_id from the
+  router's ``serve.request`` root through queue → dispatch → infer, both
+  in-process and across the framed TCP hop.
+- **Elastic correlation**: a 3-peer fleet loses a host mid-epoch; the
+  follower's restore/rebuild spans join the leader's
+  ``elastic.reconfigure`` trace (the RECONF frame's ``_trace`` carrier).
+- **Flight recorder**: bundle atomicity/layout, keep-K GC, per-trigger
+  cooldown, disabled no-op, and the full trigger matrix — healthz
+  200→503 edge, watchdog stall, non-finite guard, replica death, canary
+  rollback, autoscaler SLO breach — each producing exactly one bundle
+  per episode, sleep-free via injected clocks.
+- **Merge CLI**: shard parsing, offset-based clock alignment, Chrome
+  schema validation, bundle inspect, subprocess exit codes.
+- **ACCEPTANCE**: a real kill-a-replica soak across three OS processes
+  (router + two TCP replica servers, tracing on) yields ONE merged
+  Perfetto-loadable trace in which the router-side request span and the
+  replica-side dispatch/infer spans share a trace_id across the process
+  boundary, and the injected death produces a flight bundle containing
+  the correlated spans, the registry snapshot, and the 503 healthz
+  reasons.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.obs import configure, get_tracer
+from dcnn_tpu.obs.flight import FlightRecorder
+from dcnn_tpu.obs.registry import MetricsRegistry
+from dcnn_tpu.obs.server import TelemetryServer
+from dcnn_tpu.obs.trace import (
+    inspect_bundle, merge_shards, read_shard, validate_chrome,
+)
+from dcnn_tpu.obs.tracer import Tracer
+from dcnn_tpu.parallel import comm
+from dcnn_tpu.resilience.faults import FaultPlan, InjectedFault
+from dcnn_tpu.serve.replica import LocalReplica, ReplicaServer, TcpReplica
+from dcnn_tpu.serve.router import Router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ID_KEYS = ("trace_id", "span_id", "parent_id")
+
+
+class FakeClock:
+    __name__ = "fake_clock"
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeEngine:
+    """Batcher-compatible engine without jax: logits = x + version."""
+
+    def __init__(self, version=1, name="fake"):
+        self.input_shape = (4,)
+        self.max_batch = 8
+        self.bucket_sizes = [1, 2, 4, 8]
+        self.name = name
+        self.version = version
+        self.batch_invariant = True
+
+    def pad_to_bucket(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        b = next(s for s in self.bucket_sizes if s >= n)
+        if b > n:
+            x = np.concatenate([x, np.zeros((b - n, 4), np.float32)])
+        return x, n
+
+    def run_padded(self, x):
+        return np.asarray(x, np.float32) + self.version
+
+
+def fake_factory(version):
+    return FakeEngine(1 if version is None else version)
+
+
+@pytest.fixture
+def tracer_on():
+    """Process-global tracer enabled for one test; restored to the no-op
+    state afterwards (other suites assert the disabled-path bound)."""
+    t = configure(enabled=True)
+    t.clear()
+    yield t
+    configure(enabled=False)
+    t.clear()
+
+
+def _by_name(events):
+    out = {}
+    for e in events:
+        out.setdefault(e["name"], []).append(e)
+    return out
+
+
+# ---------------------------------------------------------------- identity
+
+def test_span_identity_and_nesting():
+    t = Tracer(enabled=True)
+    with t.span("outer") as o:
+        with t.span("inner") as i:
+            assert i.trace_id == o.trace_id
+            assert i.parent_id == o.span_id
+            assert i.span_id != o.span_id
+    with t.span("sibling") as s:
+        assert s.trace_id != o.trace_id  # fresh root = fresh trace
+        assert s.parent_id is None
+    evs = t.events()
+    assert all(e["args"]["trace_id"] and e["args"]["span_id"]
+               for e in evs)
+
+
+def test_inject_activate_round_trip():
+    t = Tracer(enabled=True)
+    assert t.inject() is None  # nothing active
+    with t.span("root") as r:
+        carrier = t.inject()
+        assert carrier == {"trace_id": r.trace_id, "span_id": r.span_id}
+    assert t.inject() is None  # exited: context popped
+    # a carrier adopted on another "thread" (same thread here) parents
+    # children under the foreign trace; instants inherit it too
+    with t.activate(carrier):
+        with t.span("child") as c:
+            assert c.trace_id == r.trace_id and c.parent_id == r.span_id
+        t.instant("blip")
+    blip = [e for e in t.events() if e["name"] == "blip"][0]
+    assert blip["args"]["trace_id"] == r.trace_id
+    # malformed / absent carriers are no-op context managers
+    with t.activate(None):
+        assert t.inject() is None
+    with t.activate({"nonsense": 1}):
+        assert t.inject() is None
+
+
+def test_begin_end_cross_thread_keeps_identity():
+    t = Tracer(enabled=True)
+    with t.span("req") as root:
+        h = t.begin("q.wait", track="queue")
+        assert h.trace_id == root.trace_id  # parent captured at begin
+
+    def closer():
+        # ending on another thread must not need (or touch) that
+        # thread's context stack
+        t.end(h, done=True)
+
+    th = threading.Thread(target=closer)
+    th.start()
+    th.join()
+    ev = [e for e in t.events() if e["name"] == "q.wait"][0]
+    assert ev["args"]["trace_id"] == root.trace_id
+    assert ev["args"]["parent_id"] == root.span_id
+
+
+def test_disabled_tracer_propagation_is_noop():
+    t = Tracer(enabled=False)
+    assert t.inject() is None
+    cm = t.activate({"trace_id": "x", "span_id": "y"})
+    with cm:
+        assert t.inject() is None
+    sp = t.span("z")
+    assert sp.context() is None  # null handle
+    assert len(t) == 0
+
+
+def test_explicit_parent_kwarg():
+    t = Tracer(enabled=True)
+    with t.span("a") as a:
+        pass
+    with t.span("b", parent=a.context()):
+        pass
+    with t.span("c", parent=a):  # a handle works as a carrier too
+        pass
+    evs = _by_name(t.events())
+    assert evs["b"][0]["args"]["trace_id"] == a.trace_id
+    assert evs["c"][0]["args"]["parent_id"] == a.span_id
+
+
+# ------------------------------------------------------------- saturation
+
+def test_ring_eviction_counts_drops_and_exports_gauges():
+    t = Tracer(enabled=True, capacity=4)
+    for i in range(10):
+        t.instant("i", n=i)
+    assert t.dropped == 6 and len(t) == 4
+    reg = MetricsRegistry()
+    t.export_gauges(reg)
+    snap = reg.snapshot()
+    assert snap["trace_events_dropped_total"] == 6
+    assert snap["trace_buffer_events"] == 4
+    assert snap["trace_buffer_capacity"] == 4
+    # delta sync: a second export without new drops adds nothing
+    t.export_gauges(reg)
+    assert reg.snapshot()["trace_events_dropped_total"] == 6
+    t.instant("i")
+    t.export_gauges(reg)
+    assert reg.snapshot()["trace_events_dropped_total"] == 7
+
+
+def test_metrics_scrape_surfaces_tracer_saturation():
+    t = Tracer(enabled=True, capacity=2)
+    for _ in range(5):
+        t.instant("x")
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg, tracer=t, port=0)
+    body = srv.metrics_body()  # the /metrics handler body, no HTTP needed
+    assert "trace_events_dropped_total 3" in body
+    assert "trace_buffer_events 2" in body
+    snap = srv.snapshot()
+    assert snap["process"]["pid"] == os.getpid()
+    assert snap["process"]["trace_events_dropped"] == 3
+
+
+# --------------------------------------------------------- wire propagation
+
+def test_channel_send_injects_trace_carrier(tracer_on):
+    srv = comm.listen(0, host="127.0.0.1")
+    port = srv.getsockname()[1]
+    ch_out = comm.connect("127.0.0.1", port, timeout=10)
+    sock, _ = srv.accept()
+    ch_in = comm.Channel(sock)
+    try:
+        with tracer_on.span("send.op") as sp:
+            ch_out.send("PING", {"k": 1})
+        cmd, meta, _ = ch_in.recv()
+        assert cmd == "PING" and meta["k"] == 1
+        assert meta["_trace"] == {"trace_id": sp.trace_id,
+                                  "span_id": sp.span_id}
+        # no active span -> no carrier; explicit carrier wins over active
+        ch_out.send("PING", {})
+        _, meta, _ = ch_in.recv()
+        assert "_trace" not in meta
+        with tracer_on.span("other"):
+            ch_out.send("PING", {"_trace": {"trace_id": "T",
+                                            "span_id": "S"}})
+        _, meta, _ = ch_in.recv()
+        assert meta["_trace"] == {"trace_id": "T", "span_id": "S"}
+    finally:
+        ch_out.close()
+        ch_in.close()
+        srv.close()
+
+
+def test_channel_send_no_carrier_when_disabled():
+    assert not get_tracer().enabled
+    srv = comm.listen(0, host="127.0.0.1")
+    ch_out = comm.connect("127.0.0.1", srv.getsockname()[1], timeout=10)
+    sock, _ = srv.accept()
+    ch_in = comm.Channel(sock)
+    try:
+        ch_out.send("PING", {"k": 1})
+        _, meta, _ = ch_in.recv()
+        assert "_trace" not in meta
+    finally:
+        ch_out.close()
+        ch_in.close()
+        srv.close()
+
+
+def test_router_request_trace_spans_local_replica(tracer_on):
+    """In-process chain: serve.request (router root) → serve.queue →
+    serve.dispatch → serve.infer all share one trace_id; parentage is a
+    chain, not a flat fan."""
+    rep = LocalReplica(fake_factory, 1, name="r0", start=False)
+    router = Router([rep])
+    fut = router.submit(np.zeros(4, np.float32))
+    rep.step(force=True)
+    assert fut.result(timeout=5) is not None
+    evs = _by_name(tracer_on.events())
+    req = evs["serve.request"][0]["args"]
+    tid = req["trace_id"]
+    q = evs["serve.queue"][0]["args"]
+    d = evs["serve.dispatch"][0]["args"]
+    inf = evs["serve.infer"][0]["args"]
+    assert q["trace_id"] == d["trace_id"] == inf["trace_id"] == tid
+    assert q["parent_id"] == req["span_id"]       # queue under request
+    assert d["parent_id"] == q["span_id"]         # dispatch under queue
+    assert inf["parent_id"] == d["span_id"]       # infer under dispatch
+    assert evs["serve.request"][0]["args"]["outcome"] == "ok"
+    router.shutdown()
+    rep.close()
+
+
+def test_router_request_trace_crosses_tcp_boundary(tracer_on):
+    """The framed hop: the infer frame's _trace carrier parents the
+    server-side spans under the router's request trace (same process,
+    real sockets — the cross-OS-process version is the acceptance soak).
+    Mixed-trace batches keep honest parentage (trace_ids list instead of
+    a fake single parent)."""
+    rep = LocalReplica(fake_factory, 1, name="r0", start=True)
+    srv = ReplicaServer(rep, port=0)
+    cli = TcpReplica("127.0.0.1", srv.port, name="tcp0")
+    router = Router([cli])
+    try:
+        # handshake measured a clock offset (same process: ~0)
+        assert cli.clock_offset_s is not None
+        assert abs(cli.clock_offset_s) < 1.0
+        fut = router.submit(np.zeros(4, np.float32))
+        assert fut.result(timeout=10) is not None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            evs = _by_name(tracer_on.events())
+            if "serve.infer" in evs:
+                break
+            time.sleep(0.01)
+        req = evs["serve.request"][0]["args"]
+        tid = req["trace_id"]
+        assert any(e["args"].get("trace_id") == tid
+                   for e in evs["serve.queue"])
+        assert any(e["args"].get("trace_id") == tid
+                   for e in evs["serve.dispatch"])
+        assert any(e["args"].get("trace_id") == tid
+                   for e in evs["serve.infer"])
+    finally:
+        router.shutdown()
+        cli.close()
+        srv.close()
+        rep.close()
+
+
+def test_mixed_trace_batch_records_trace_ids_list(tracer_on):
+    """Two requests with different traces coalescing into one dispatch:
+    the dispatch span cannot claim a single parent — it records the
+    trace-id list instead."""
+    rep = LocalReplica(fake_factory, 1, name="r0", start=False)
+    router = Router([rep])
+    f1 = router.submit(np.zeros(4, np.float32))
+    f2 = router.submit(np.ones(4, np.float32))
+    rep.step(force=True)
+    assert f1.result(timeout=5) is not None
+    assert f2.result(timeout=5) is not None
+    evs = _by_name(tracer_on.events())
+    reqs = {e["args"]["trace_id"] for e in evs["serve.request"]}
+    assert len(reqs) == 2
+    d = evs["serve.dispatch"][0]["args"]
+    assert set(d["trace_ids"]) == reqs
+    assert "parent_id" not in d
+    router.shutdown()
+    rep.close()
+
+
+# --------------------------------------------------------------- merge CLI
+
+def _write_shard(path, epoch, spans, clock_name="fake"):
+    fc = FakeClock(epoch)
+    t = Tracer(clock=fc, enabled=True)
+    for (name, t0, t1, track, attrs) in spans:
+        t.record_span(name, t0, t1, track=track, **attrs)
+    t.export_jsonl(path)
+    return t
+
+
+def test_merge_aligns_clocks_and_validates(tmp_path):
+    """Two shards whose clocks disagree by exactly 100 s merge onto one
+    timeline when the handshake-measured offset is passed — the span
+    that happened 0.1 s after the request lands 0.1 s after it in the
+    merged trace, in a Chrome file that passes schema validation."""
+    a = str(tmp_path / "router.jsonl")
+    b = str(tmp_path / "replica.jsonl")
+    _write_shard(a, 0.0, [("serve.request", 1.0, 1.5, "router",
+                           {"trace_id": "T1", "span_id": "S1"})])
+    _write_shard(b, 100.0, [("serve.dispatch", 101.1, 101.3, "serve",
+                             {"trace_id": "T1", "parent_id": "S1"})])
+    out = str(tmp_path / "merged.json")
+    summary = merge_shards([a, b], out,
+                           offsets={"replica.jsonl": 100.0})
+    assert validate_chrome(out) == []
+    assert summary["events"] == 2 and summary["trace_ids"] == 1
+    doc = json.load(open(out))
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    req, dsp = spans["serve.request"], spans["serve.dispatch"]
+    assert req["args"]["trace_id"] == dsp["args"]["trace_id"] == "T1"
+    assert req["ts"] == 0.0                      # normalized to t=0
+    assert abs(dsp["ts"] - 100_000.0) < 1e-6     # 0.1 s later, in µs
+    assert req["pid"] != dsp["pid"]              # one pid per process
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(names) == 2
+
+
+def test_merge_reads_header_offset_and_reports_drops(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    t = _write_shard(p, 10.0, [("op", 10.0, 10.5, "x",
+                                {"trace_id": "T", "span_id": "S"})])
+    # rewrite with a header-carried offset + a fake drop count
+    meta, events = read_shard(p)
+    assert meta["epoch_s"] == 10.0 and meta["clock"] == "fake_clock"
+    t._dropped = 3
+    t.export_jsonl(p)
+    meta, _ = read_shard(p)
+    assert meta["dropped"] == 3
+    out = str(tmp_path / "m.json")
+    summary = merge_shards([p], out)
+    assert summary["events_dropped_by_writers"] == 3
+    assert validate_chrome(out) == []
+
+
+def test_merge_cli_subprocess_and_inspect(tmp_path):
+    shard = str(tmp_path / "s.jsonl")
+    _write_shard(shard, 0.0, [("op", 0.0, 1.0, "x",
+                               {"trace_id": "T", "span_id": "S"})])
+    out = str(tmp_path / "m.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "dcnn_tpu.obs.trace", "merge", shard,
+         "-o", out, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["events"] == 1
+    assert validate_chrome(out) == []
+    # bad usage -> exit 2; unreadable shard -> exit 1
+    r = subprocess.run([sys.executable, "-m", "dcnn_tpu.obs.trace"],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 2
+    r = subprocess.run(
+        [sys.executable, "-m", "dcnn_tpu.obs.trace", "merge",
+         str(tmp_path / "missing.jsonl"), "-o", out],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    # inspect a flight bundle end to end
+    t = Tracer(enabled=True)
+    with t.span("a.b", trace_marker=1):
+        pass
+    rec = FlightRecorder(str(tmp_path / "flight"), tracer=t,
+                         registry=MetricsRegistry(), min_interval_s=0.0)
+    bundle = rec.record("unit_test", reasons=["because"],
+                        health={"status": "unhealthy",
+                                "reasons": ["because"]})
+    info = inspect_bundle(bundle)
+    assert info["manifest"]["trigger"] == "unit_test"
+    assert info["spans"] == 1 and info["trace_ids"] == 1
+    assert info["healthz"]["status"] == "unhealthy"
+    r = subprocess.run(
+        [sys.executable, "-m", "dcnn_tpu.obs.trace", "inspect", bundle],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["manifest"]["trigger"] == "unit_test"
+    # a bundle's spans.jsonl merges like any live shard
+    summary = merge_shards([os.path.join(bundle, "spans.jsonl")],
+                           str(tmp_path / "bm.json"))
+    assert summary["events"] == 1
+
+
+def test_validate_chrome_flags_garbage(tmp_path):
+    p = str(tmp_path / "bad.json")
+    with open(p, "w") as f:
+        json.dump({"traceEvents": [{"ph": "X", "name": "a"}]}, f)
+    problems = validate_chrome(p)
+    assert problems  # missing pid/tid/ts/dur all flagged
+    with open(p, "w") as f:
+        f.write("not json")
+    assert validate_chrome(p)
+
+
+# ---------------------------------------------------------- flight recorder
+
+def test_flight_bundle_layout_gc_cooldown(tmp_path):
+    clk = FakeClock()
+    t = Tracer(enabled=True)
+    with t.span("x.y"):
+        pass
+    reg = MetricsRegistry()
+    reg.counter("some_total").inc(5)
+    rec = FlightRecorder(str(tmp_path / "fl"), keep=2, min_interval_s=10.0,
+                         tracer=t, registry=reg, clock=clk,
+                         wall_clock=lambda: 1000.0 + clk.t)
+    p = rec.record("replica_death", reasons=["r0 died"],
+                   health={"status": "unhealthy", "reasons": ["r0"]},
+                   config={"knob": 1}, extra={"replica": "r0"})
+    assert p is not None
+    files = set(os.listdir(p))
+    assert {"MANIFEST.json", "spans.jsonl", "metrics.json",
+            "healthz.json", "config.json", "extra.json"} <= files
+    man = json.load(open(os.path.join(p, "MANIFEST.json")))
+    assert man["trigger"] == "replica_death"
+    assert man["reasons"] == ["r0 died"]
+    assert json.load(open(os.path.join(p, "metrics.json")))[
+        "some_total"] == 5
+    # cooldown: same trigger within min_interval_s is suppressed
+    assert rec.record("replica_death") is None
+    # ...but a different trigger is not
+    assert rec.record("watchdog_stall") is not None
+    clk.advance(11.0)
+    assert rec.record("replica_death") is not None
+    # keep-K GC: only the 2 newest remain, newest first in the listing
+    bundles = rec.bundles()
+    assert len(bundles) == 2
+    assert bundles[0]["trigger"] == "replica_death"
+    assert reg.snapshot()["flight_records_total"] == 3
+    assert reg.snapshot()["flight_records_suppressed_total"] == 1
+    # no stray staging dirs after commits
+    assert not [n for n in os.listdir(rec.directory)
+                if n.startswith("tmp-")]
+
+
+def test_flight_disabled_and_never_raises(tmp_path):
+    rec = FlightRecorder(None)
+    assert not rec.enabled
+    assert rec.record("anything") is None
+    assert rec.bundles() == []
+    # a recorder pointed at an unwritable path swallows the failure and
+    # counts it — record() must never raise into a dispatch callback
+    reg = MetricsRegistry()
+    bad = FlightRecorder("/proc/definitely/not/writable",
+                         registry=reg, min_interval_s=0.0)
+    assert bad.record("x") is None
+    assert reg.snapshot()["flight_record_failures_total"] == 1
+
+
+def test_flight_failed_dump_releases_the_cooldown(tmp_path):
+    """A failed dump must not consume the per-trigger cooldown: the
+    NEXT edge of the same trigger (e.g. the real replica death right
+    after a transient ENOSPC) still records its evidence."""
+    reg = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path / "fl"), registry=reg,
+                         min_interval_s=3600.0)  # huge window on purpose
+    orig = rec._dump
+    fail_next = [True]
+
+    def flaky_dump(*a, **kw):
+        if fail_next[0]:
+            fail_next[0] = False
+            raise OSError("disk full")
+        return orig(*a, **kw)
+
+    rec._dump = flaky_dump
+    assert rec.record("replica_death") is None  # failed, counted
+    assert reg.snapshot()["flight_record_failures_total"] == 1
+    # within the (hour-long) cooldown window, yet NOT suppressed —
+    # the failed claim was released
+    assert rec.record("replica_death") is not None
+    assert "flight_records_suppressed_total" not in reg.snapshot()
+    # a third call IS suppressed: the successful dump owns the window
+    assert rec.record("replica_death") is None
+    assert reg.snapshot()["flight_records_suppressed_total"] == 1
+
+
+def test_healthz_edge_dumps_exactly_one_bundle_per_episode(tmp_path):
+    reg = MetricsRegistry()
+    t = Tracer(enabled=True)
+    rec = FlightRecorder(str(tmp_path / "fl"), tracer=t, registry=reg,
+                         min_interval_s=0.0)
+    healthy = [True]
+    srv = TelemetryServer(registry=reg, tracer=t, port=0)
+    srv.set_identity(component="unit", name="edge-test")
+    srv.attach_flight(rec)
+    srv.add_check("unit", lambda: None if healthy[0] else "broken: x")
+    code, _ = srv.health()
+    assert code == 200 and rec.bundles() == []
+    healthy[0] = False
+    code, body = srv.health()
+    assert code == 503
+    assert rec.bundles()[0]["trigger"] == "healthz_degraded"
+    # still degraded: NO second bundle (edge, not level)
+    srv.health()
+    assert len(rec.bundles()) == 1
+    # recover, degrade again: a new episode records again
+    healthy[0] = True
+    srv.health()
+    healthy[0] = False
+    srv.health()
+    assert len(rec.bundles()) == 2
+    hz = json.load(open(os.path.join(rec.bundles()[0]["path"],
+                                     "healthz.json")))
+    assert hz["status"] == "unhealthy"
+    assert any("broken" in r for r in hz["reasons"])
+    # /snapshot lists the bundles + the process trace identity
+    snap = srv.snapshot()
+    assert snap["flight"]["enabled"]
+    assert len(snap["flight"]["bundles"]) == 2
+    assert snap["process"]["component"] == "unit"
+    assert snap["process"]["name"] == "edge-test"
+
+
+def test_watchdog_stall_trigger(tmp_path):
+    from dcnn_tpu.resilience.guards import StallWatchdog
+
+    fc = FakeClock()
+    reg = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path / "fl"), registry=reg,
+                         min_interval_s=0.0)
+    wd = StallWatchdog(5.0, clock=fc, registry=reg, flight=rec)
+    wd.beat()
+    fc.advance(6.0)
+    with pytest.warns(UserWarning):
+        assert wd.check()
+    bundles = rec.bundles()
+    assert [b["trigger"] for b in bundles] == ["watchdog_stall"]
+    # repeated checks during ONE stall: edge-triggered, no new bundle
+    assert wd.check()
+    assert len(rec.bundles()) == 1
+    wd.beat()
+    fc.advance(6.0)
+    with pytest.warns(UserWarning):
+        wd.check()
+    assert len(rec.bundles()) == 2
+
+
+def test_nonfinite_guard_trigger(tmp_path):
+    from dcnn_tpu.resilience.guards import NonFiniteError, StepGuard
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path / "fl"), registry=reg,
+                         min_interval_s=0.0)
+    g = StepGuard("skip_step", registry=reg, flight=rec)
+    assert g.observe(1, bad=False) == "ok"
+    assert rec.bundles() == []
+    with pytest.warns(UserWarning):
+        assert g.observe(2, bad=True, loss=float("nan")) == "skipped"
+    assert [b["trigger"] for b in rec.bundles()] == ["nonfinite_guard"]
+    # mid-streak: no new bundle (edge = streak start)
+    with pytest.warns(UserWarning):
+        g.observe(3, bad=True)
+    assert len(rec.bundles()) == 1
+    # recovery then a new streak records again
+    g.observe(4, bad=False)
+    with pytest.warns(UserWarning):
+        g.observe(5, bad=True)
+    assert len(rec.bundles()) == 2
+    # policy 'raise' records before aborting
+    g2 = StepGuard("raise", registry=reg, flight=rec)
+    with pytest.raises(NonFiniteError):
+        g2.observe(9, bad=True, loss=float("inf"))
+    assert len(rec.bundles()) == 3
+
+
+def test_replica_death_trigger_through_router(tmp_path):
+    reg_rec = FlightRecorder(str(tmp_path / "fl"), min_interval_s=0.0)
+    rep0 = LocalReplica(fake_factory, 1, name="r0", start=False)
+    rep1 = LocalReplica(fake_factory, 1, name="r1", start=False)
+    router = Router([rep0, rep1], flight=reg_rec, min_routable=1)
+    rep1.kill()
+    router.check_replicas()
+    bundles = reg_rec.bundles()
+    assert [b["trigger"] for b in bundles] == ["replica_death"]
+    extra = json.load(open(os.path.join(bundles[0]["path"],
+                                        "extra.json")))
+    assert extra["replica"] == "r1"
+    # metrics.json is the ROUTER's registry (death already counted)
+    metrics = json.load(open(os.path.join(bundles[0]["path"],
+                                          "metrics.json")))
+    assert metrics["serve_router_replica_deaths_total"] == 1
+    # the sweep seeing the same dead replica again is not a new edge
+    router.check_replicas()
+    assert len(reg_rec.bundles()) == 1
+    router.shutdown()
+    rep0.close()
+
+
+def test_canary_rollback_trigger(tmp_path):
+    from dcnn_tpu.serve.swap import ModelVersionManager
+
+    fc = FakeClock()
+    rec = FlightRecorder(str(tmp_path / "fl"), min_interval_s=0.0)
+    plans = {f"r{i}": FaultPlan() for i in range(4)}
+
+    class Factory:
+        newest_version = 2
+
+        def newest(self):
+            return self.newest_version
+
+        def __call__(self, version):
+            return FakeEngine(version)
+
+    reps = [LocalReplica(Factory(), 1, name=f"r{i}", clock=fc,
+                         fault_plan=plans[f"r{i}"], start=False)
+            for i in range(4)]
+    router = Router(reps, clock=fc, sleep=lambda s: fc.advance(s))
+    mvm = ModelVersionManager(router, Factory(), canary_fraction=0.25,
+                              observe_s=10.0, min_canary_requests=5,
+                              max_error_delta=0.02, clock=fc, flight=rec)
+    res = mvm.poll()
+    assert res["action"] == "canary"
+    canary = res["canaries"][0]
+    plans[canary].arm("serve.replica_infer", exc=InjectedFault)
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(32)]
+    for _ in range(6):
+        for r in reps:
+            r.step(force=True)
+    assert all(f.exception(timeout=5) is None for f in futs)
+    res = mvm.poll()
+    assert res["action"] == "rolled_back"
+    bundles = rec.bundles()
+    assert "canary_rollback" in [b["trigger"] for b in bundles]
+    cb = [b for b in bundles if b["trigger"] == "canary_rollback"][0]
+    cfg = json.load(open(os.path.join(cb["path"], "config.json")))
+    assert cfg["version"] == 2 and canary in cfg["canaries"]
+    router.shutdown()
+    for r in reps:
+        try:
+            r.close()
+        except Exception:
+            pass
+
+
+def test_autoscale_slo_breach_trigger(tmp_path, monkeypatch):
+    from dcnn_tpu.serve.autoscale import Autoscaler, AutoscalerConfig
+    from dcnn_tpu.serve.autoscale import FleetSignals
+
+    fc = FakeClock()
+    rec = FlightRecorder(str(tmp_path / "fl"), min_interval_s=0.0)
+    boot = LocalReplica(fake_factory, 1, name="boot", clock=fc,
+                        start=False)
+    router = Router([boot], clock=fc, sleep=lambda s: fc.advance(s))
+    made = [0]
+
+    def factory(version):
+        made[0] += 1
+        return LocalReplica(fake_factory, version, name=f"as{made[0]}",
+                            clock=fc, start=False)
+
+    scaler = Autoscaler(router, factory,
+                        config=AutoscalerConfig(breach_ticks=1,
+                                                up_cooldown_s=0.0),
+                        clock=fc, flight=rec)
+    signals = {"p99": 1000.0}
+
+    def fake_collect(*, _commit=False):
+        return FleetSignals(routable=1, utilization=0.5,
+                            p99_ms=signals["p99"], shed_fraction=0.0)
+
+    monkeypatch.setattr(scaler, "collect", fake_collect)
+    fc.advance(1.0)
+    scaler.tick()  # p99 1000ms > slo default: breach edge
+    assert [b["trigger"] for b in rec.bundles()] == ["autoscale_slo_breach"]
+    hz = json.load(open(os.path.join(rec.bundles()[0]["path"],
+                                     "extra.json")))
+    assert hz["p99_ms"] == 1000.0
+    fc.advance(1.0)
+    scaler.tick()  # still breaching: same episode, no new bundle
+    assert len(rec.bundles()) == 1
+    signals["p99"] = 1.0
+    fc.advance(1.0)
+    scaler.tick()  # recovered
+    signals["p99"] = 1000.0
+    fc.advance(1.0)
+    scaler.tick()  # new episode
+    assert len(rec.bundles()) == 2
+    router.shutdown()
+
+
+# ------------------------------------------------------ elastic correlation
+
+@pytest.mark.parametrize("victim", [2])
+def test_elastic_reconfiguration_is_one_trace(tmp_path, tracer_on,
+                                              victim):
+    """3 peers, one killed mid-epoch: the follower's restore/rebuild
+    spans join the LEADER's elastic.reconfigure trace via the RECONF
+    frame's _trace carrier — a reconfiguration reads as one cross-host
+    timeline. (In-process controllers share the global tracer, but the
+    context still travels through real loopback sockets: without the
+    carrier the follower thread has no ancestry at all.)"""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel.elastic import ElasticController, PeerSpec
+    from dcnn_tpu.resilience.faults import InjectedCrash
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 16)).astype(np.float32)
+    y = one_hot(rng.integers(0, 4, 48), 4)
+    n = 3
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(n)]
+    peers = [PeerSpec(i, "127.0.0.1", s.getsockname()[1])
+             for i, s in enumerate(socks)]
+    faults = {victim: FaultPlan().arm("elastic.heartbeat", at=5,
+                                      exc=InjectedCrash)}
+    ckpt = str(tmp_path / "ckpt")
+    results = {}
+
+    def runner(i):
+        cfg = TrainingConfig(
+            epochs=2, learning_rate=0.05, seed=3, snapshot_dir=None,
+            elastic=True, elastic_microbatches=6, elastic_timeout_s=15.0,
+            elastic_heartbeat_s=0.0, elastic_ckpt_steps=2,
+            checkpoint_dir=ckpt)
+        model = (SequentialBuilder("elastic_model").input((16,))
+                 .dense(32).activation("relu").dense(4).build())
+        ctl = ElasticController(
+            model, SGD(0.05), "softmax_crossentropy",
+            ArrayDataLoader(x, y, batch_size=12, seed=7),
+            config=cfg, rank=i, peers=peers, listen_sock=socks[i],
+            fault_plan=faults.get(i))
+        try:
+            results[i] = ctl.fit(epochs=2)
+        except InjectedCrash:
+            results[i] = "crashed"
+        except Exception as e:
+            results[i] = e
+
+    threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "elastic fleet hung"
+    assert results[victim] == "crashed"
+    for r in (0, 1):
+        assert not isinstance(results[r], (str, Exception)), results[r]
+
+    evs = _by_name(tracer_on.events())
+    # the leader (rank 0) drove a reconfiguration to a generation > 0
+    recs = [e for e in evs.get("elastic.reconfigure", [])
+            if e["args"].get("rank") == 0 and e["args"].get("gen", 0) > 0]
+    assert recs, evs.keys()
+    lead_tid = recs[-1]["args"]["trace_id"]
+    # the follower's (rank 1) restore AND rebuild joined that trace
+    for phase in ("elastic.restore", "elastic.rebuild"):
+        joined = [e for e in evs.get(phase, [])
+                  if e["args"].get("rank") == 1
+                  and e["args"].get("trace_id") == lead_tid]
+        assert joined, (phase, [e["args"] for e in evs.get(phase, [])])
+    # generation steps carry the same trace (the per-generation timeline)
+    stepped = [e for e in evs.get("elastic.step", [])
+               if e["args"].get("trace_id") == lead_tid]
+    assert stepped
+
+
+# ------------------------------------------------------------- ACCEPTANCE
+
+_CHILD = """\
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from dcnn_tpu.obs import configure
+from dcnn_tpu.serve.replica import LocalReplica, ReplicaServer
+from dcnn_tpu.serve.soak import synthetic_engine_factory
+
+shard, name = sys.argv[1], sys.argv[2]
+tracer = configure(enabled=True)
+tracer.process_name = name
+rep = LocalReplica(synthetic_engine_factory, 1, name=name, start=True)
+srv = ReplicaServer(rep, port=0)
+print(srv.port, flush=True)
+
+def _flush(*_a):
+    try:
+        tracer.export_jsonl(shard)
+    finally:
+        os._exit(0)
+
+signal.signal(signal.SIGTERM, _flush)
+while True:
+    time.sleep(0.05)
+    tracer.export_jsonl(shard)
+"""
+
+
+def _spawn_replica_process(tmp_path, name):
+    shard = str(tmp_path / f"{name}.jsonl")
+    script = str(tmp_path / f"{name}_main.py")
+    with open(script, "w") as f:
+        f.write(_CHILD.format(repo=REPO))
+    proc = subprocess.Popen(
+        [sys.executable, script, shard, name],
+        stdout=subprocess.PIPE, text=True, cwd=REPO)
+    port_line = proc.stdout.readline().strip()
+    assert port_line, "replica child died before binding"
+    return proc, int(port_line), shard
+
+
+def test_acceptance_kill_a_replica_merged_trace_and_flight(tmp_path,
+                                                           tracer_on):
+    """ISSUE-12 ACCEPTANCE: a kill-a-replica router soak with tracing on
+    across three OS processes yields ONE merged Perfetto-loadable trace
+    in which the router-side request span and the replica-side
+    dispatch/infer spans share a trace_id across the process boundary,
+    and the injected death produces a flight bundle containing the
+    correlated spans, the registry snapshot, and the 503 healthz
+    reasons."""
+    tracer_on.process_name = "router"
+    flight_dir = str(tmp_path / "flight")
+    rec = FlightRecorder(flight_dir, min_interval_s=0.0,
+                         tracer=tracer_on)
+    proc_a = proc_b = None
+    router = None
+    clients = []
+    try:
+        proc_a, port_a, shard_a = _spawn_replica_process(tmp_path, "repA")
+        proc_b, port_b, shard_b = _spawn_replica_process(tmp_path, "repB")
+        cli_a = TcpReplica("127.0.0.1", port_a, name="repA",
+                           timeout_s=30.0, connect_timeout=60.0)
+        cli_b = TcpReplica("127.0.0.1", port_b, name="repB",
+                           timeout_s=30.0, connect_timeout=60.0)
+        clients = [cli_a, cli_b]
+        # min_routable=2: losing one replica degrades /healthz — the 503
+        # whose reasons the flight bundle must carry
+        router = Router([cli_a, cli_b], min_routable=2, flight=rec)
+        srv = router.start_telemetry(port=0)
+
+        # soak phase 1: traffic over the healthy fleet (both replicas)
+        sample = np.zeros((4,), np.float32)
+        futs = [router.submit(sample) for _ in range(24)]
+        results = [f.result(timeout=30) for f in futs]
+        assert all(np.asarray(r) is not None for r in results)
+        code, _ = srv.health()
+        assert code == 200
+
+        # the injected death: SIGTERM repB (its handler exports the
+        # trace shard, then exits — the kernel closing its sockets is
+        # what the router's liveness layer sees)
+        proc_b.send_signal(signal.SIGTERM)
+        proc_b.wait(timeout=30)
+
+        # the scrape-driven sweep detects the death, ejects, and the
+        # healthz edge fires: poll the REAL health endpoint body
+        deadline = time.monotonic() + 30.0
+        code, body = 200, {}
+        while time.monotonic() < deadline:
+            code, body = srv.health()
+            if code == 503:
+                break
+            time.sleep(0.05)
+        assert code == 503, body
+        assert any("routable" in r for r in body["reasons"])
+
+        # soak phase 2: survivors absorb traffic (no silent drops)
+        futs = [router.submit(sample) for _ in range(8)]
+        for f in futs:
+            assert f.result(timeout=30) is not None
+        assert router.outstanding() == 0
+
+        # ---- ONE merged Perfetto-loadable trace ----
+        shard_r = str(tmp_path / "router.jsonl")
+        tracer_on.export_jsonl(shard_r)
+        merged = str(tmp_path / "merged_trace.json")
+        summary = merge_shards([shard_r, shard_a, shard_b], merged)
+        assert validate_chrome(merged) == []
+        assert summary["events"] > 0
+
+        # cross-process correlation: a router-side serve.request span
+        # shares its trace_id with a replica-side dispatch/infer span
+        _meta_r, evs_r = read_shard(shard_r)
+        req_tids = {e["args"]["trace_id"] for e in evs_r
+                    if e["name"] == "serve.request"}
+        assert req_tids
+        replica_side_tids = set()
+        for shard in (shard_a, shard_b):
+            _m, evs = read_shard(shard)
+            for e in evs:
+                if e["name"] in ("serve.dispatch", "serve.infer",
+                                 "serve.queue"):
+                    tid = (e.get("args") or {}).get("trace_id")
+                    if tid:
+                        replica_side_tids.add(tid)
+        shared = req_tids & replica_side_tids
+        assert shared, (sorted(req_tids)[:3],
+                        sorted(replica_side_tids)[:3])
+        # and the merged artifact itself carries both sides of one trace
+        doc = json.load(open(merged))
+        tid = next(iter(shared))
+        pids = {e["pid"] for e in doc["traceEvents"]
+                if e["ph"] != "M" and e["args"].get("trace_id") == tid}
+        assert len(pids) >= 2  # the SAME trace spans >= 2 processes
+
+        # ---- the flight bundle ----
+        triggers = {b["trigger"]: b for b in rec.bundles()}
+        assert "replica_death" in triggers
+        assert "healthz_degraded" in triggers
+        hb = triggers["healthz_degraded"]["path"]
+        hz = json.load(open(os.path.join(hb, "healthz.json")))
+        assert hz["status"] == "unhealthy"
+        assert any("routable" in r for r in hz["reasons"])  # 503 reasons
+        metrics = json.load(open(os.path.join(hb, "metrics.json")))
+        assert metrics["serve_router_replica_deaths_total"] >= 1
+        # correlated spans: the bundle's span shard holds serve.request
+        # spans whose trace_id the replica-side shards also carry
+        _bm, bundle_evs = read_shard(os.path.join(hb, "spans.jsonl"))
+        bundle_tids = {(e.get("args") or {}).get("trace_id")
+                       for e in bundle_evs
+                       if e["name"] == "serve.request"}
+        assert bundle_tids & replica_side_tids
+    finally:
+        if router is not None:
+            try:
+                router.shutdown(drain=False)
+            except Exception:
+                pass
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in (proc_a, proc_b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

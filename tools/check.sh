@@ -12,11 +12,16 @@
 #      concurrency / atomicity suite against the committed baseline
 #      (docs/static_analysis.md). Zero unsuppressed findings required;
 #      this covers dcnn_tpu/aot/ (CC03 resource-lifecycle applies to its
-#      cross-process file locks) and the autoscaler pair
+#      cross-process file locks), the autoscaler pair
 #      serve/autoscale.py + parallel/autoscale.py (CC01 guarded_by
 #      discipline on shared scaler/broker/lease state, CC02 on the
-#      control-loop poll thread and leased-segment runners) — all with
-#      zero baseline entries.
+#      control-loop poll thread and leased-segment runners), and the
+#      distributed-tracing layer obs/flight.py + obs/trace.py (AT01
+#      atomic-commit on bundle staging and the merged-trace write, CC01
+#      on the recorder's cooldown/seq state and the healthz edge lock)
+#      — all with zero baseline entries. The tracer's context plumbing
+#      keeps the disabled-path <100 ns no-op bound, asserted in
+#      tests/test_obs.py (propagation must cost nothing when off).
 #   3. benchmarks/compare.py --self-test — the bench regression gate's own
 #      fixture run (planted 25% drop must flag; clean history must pass).
 #
