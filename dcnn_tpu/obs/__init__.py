@@ -71,13 +71,13 @@ from .flight import FlightRecorder, configure_flight, get_flight_recorder
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry)
 from .server import (TelemetryServer, checkpoint_check, elastic_check,
-                     watchdog_check)
+                     pipeline_check, watchdog_check)
 from .tracer import Tracer, configure, get_tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Tracer", "configure", "get_tracer",
     "TelemetryServer", "watchdog_check", "checkpoint_check",
-    "elastic_check",
+    "elastic_check", "pipeline_check",
     "FlightRecorder", "get_flight_recorder", "configure_flight",
 ]

@@ -127,6 +127,23 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("autoscale.scale_up_reaction_s",
                "autoscale.scale_up_reaction_s", higher_is_better=False,
                tolerance=0.5, guard="autoscale.up_cooldown_s", atol=5.0),
+    # the pipeline kill-a-stage probe (BENCH_FAULTS=1, ISSUE 13):
+    # detection + repartition-and-resume walls are loopback sub-second
+    # numbers with scheduler noise, hence the atol slack; batches_lost is
+    # correctness-adjacent (the journal contract says 0), so ANY increase
+    # flags. Guarded on the probe's stage count — a topology change is
+    # config, not regression. Pre-PR-13 captures lack the block and are
+    # skipped, not lied about.
+    MetricSpec("pipeline.detection_s", "resilience.pipeline.detection_s",
+               higher_is_better=False, tolerance=1.0, atol=0.5,
+               guard="resilience.pipeline.stages"),
+    MetricSpec("pipeline.repartition_wall_s",
+               "resilience.pipeline.repartition_wall_s",
+               higher_is_better=False, tolerance=1.0, atol=2.0,
+               guard="resilience.pipeline.stages"),
+    MetricSpec("pipeline.batches_lost", "resilience.pipeline.batches_lost",
+               higher_is_better=False, tolerance=0.0,
+               guard="resilience.pipeline.stages"),
 )
 
 DEFAULT_TOLERANCE = 0.2

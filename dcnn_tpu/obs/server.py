@@ -66,6 +66,12 @@ _HEALTH_FLAGS = (
     "ckpt_last_step", "ckpt_saves_total", "ckpt_restore_skipped_total",
     "elastic_generation", "elastic_world_size", "elastic_reconfiguring",
     "elastic_reconfigures_total", "elastic_peers_lost_total",
+    # TCP pipeline (parallel/distributed_pipeline.py): generation + stage
+    # count + the recovery counters a prober wants next to the verdict
+    "pipeline_generation", "pipeline_stages", "pipeline_recovering",
+    "pipeline_stages_lost_total", "pipeline_recoveries_total",
+    "pipeline_stage_respawns_total", "pipeline_replayed_batches_total",
+    "pipeline_batches_lost_total",
     # router tier (serve/router.py): fleet shape + the counters a prober
     # wants next to the 200/503 verdict
     "serve_router_replicas", "serve_router_replicas_routable",
@@ -113,6 +119,25 @@ def elastic_check(controller) -> Callable[[], Optional[str]]:
             return (f"elastic reconfiguration in flight "
                     f"(generation {getattr(controller, 'generation', '?')}, "
                     f"world {getattr(controller, 'world', '?')})")
+        return None
+    return _check
+
+
+def pipeline_check(coordinator) -> Callable[[], Optional[str]]:
+    """Health check over a
+    :class:`~dcnn_tpu.parallel.distributed_pipeline.DistributedPipelineCoordinator`:
+    degraded **while a stage-loss recovery is in flight** — the
+    coordinator is mid-sweep / restoring a commit / replaying the batch
+    journal and is not making forward progress on new batches, so a fleet
+    scheduler should treat the run like a draining replica, not a dead
+    one. Healthy again the moment the re-shipped generation is serving
+    (the body's ``pipeline_generation`` / ``pipeline_stages`` flags say
+    what it recovered *to*)."""
+    def _check() -> Optional[str]:
+        if getattr(coordinator, "recovering", False):
+            return (f"pipeline recovery in flight "
+                    f"(generation {getattr(coordinator, 'generation', '?')}, "
+                    f"stages {getattr(coordinator, 'num_stages', '?')})")
         return None
     return _check
 

@@ -52,6 +52,49 @@ class Optimizer:
     def name(self) -> str:
         return type(self).__name__
 
+    # -- pipeline split/merge (parallel/distributed_pipeline.py recovery) --
+    #
+    # Structural contract shared by the built-in optimizers: ``opt_state``
+    # is a dict whose values are either *per-layer* sequences shaped like
+    # the params tuple (SGD velocity, Adam m/v — split/concatenated along
+    # layer ranges) or *whole-run* leaves identical on every stage (Adam's
+    # step counter t — replicated on split, taken from the first stage on
+    # merge). A custom optimizer whose state breaks this convention must
+    # override both methods; the pipeline recovery path round-trips
+    # optimizer state through them so a repartition preserves momentum.
+
+    def split_state(self, opt_state: OptState,
+                    partitions) -> "list[OptState]":
+        """Partition a full-model optimizer state alongside
+        ``Sequential.split_params`` into one state per layer-range."""
+        total = max(end for _, end in partitions)
+        out = []
+        for start, end in partitions:
+            st: OptState = {}
+            for k, v in opt_state.items():
+                if isinstance(v, (tuple, list)) and len(v) == total:
+                    st[k] = tuple(v[start:end])
+                else:
+                    st[k] = v
+            out.append(st)
+        return out
+
+    def merge_state(self, states, partitions) -> OptState:
+        """Inverse of :meth:`split_state`: concatenate per-layer sequences
+        across the stage states (given in partition order), keep the first
+        stage's copy of whole-run leaves (identical by construction — the
+        stages apply updates in lockstep)."""
+        merged: OptState = {}
+        for k, v0 in states[0].items():
+            if isinstance(v0, (tuple, list)):
+                seq: list = []
+                for st in states:
+                    seq.extend(st[k])
+                merged[k] = tuple(seq)
+            else:
+                merged[k] = v0
+        return merged
+
 
 class SGD(Optimizer):
     def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0):

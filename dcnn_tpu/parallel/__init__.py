@@ -55,7 +55,8 @@ from .sequence import (
     zigzag_shard,
 )
 from .distributed_pipeline import (
-    DistributedPipelineCoordinator, PipelineWorkerError,
+    DistributedPipelineCoordinator, PipelineCollapsedError,
+    PipelineTimeouts, PipelineWorkerError, StageLostError,
 )
 from .worker import StageWorker, run_worker
 
@@ -76,5 +77,6 @@ __all__ = [
     "make_zigzag_ring_attention", "shard_sequence", "zigzag_permutation",
     "zigzag_shard",
     "DistributedPipelineCoordinator", "PipelineWorkerError",
+    "StageLostError", "PipelineCollapsedError", "PipelineTimeouts",
     "StageWorker", "run_worker",
 ]

@@ -217,6 +217,12 @@ class Inbox:
         except queue.Empty:
             raise TimeoutError("no message within timeout") from None
 
+    def post(self, cmd: str, meta: Optional[Dict] = None,
+             payload: Any = None, chan: Optional[Channel] = None) -> None:
+        """Inject a local frame — wakeup sentinels (``StageWorker.stop``)
+        and tests, without reaching into the queue's representation."""
+        self._q.put((cmd, dict(meta or {}), payload, chan))
+
 
 def listen(port: int, host: str = "0.0.0.0") -> socket.socket:
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -228,7 +234,8 @@ def listen(port: int, host: str = "0.0.0.0") -> socket.socket:
 
 def connect(host: str, port: int, *, timeout: float = 60.0,
             delay: float = 0.2, compress: bool = False,
-            sleep=time.sleep, clock=time.monotonic) -> Channel:
+            sleep=time.sleep, clock=time.monotonic,
+            name: str = "pipeline_connect") -> Channel:
     """Connect through the shared bounded-backoff primitive
     (``resilience/retry.py``) — workers may come up in any order and can
     take tens of seconds to import jax on a slow host (the reference
@@ -236,9 +243,12 @@ def connect(host: str, port: int, *, timeout: float = 60.0,
 
     Backoff starts at ``delay`` and doubles (jittered) to a 2 s cap until
     ``timeout`` elapses; every retry lands on the obs registry
-    (``pipeline_connect_retry_attempts_total``), so a worker flapping its
-    way up is visible, not silent. ``sleep``/``clock`` are injectable for
-    sleep-free tests."""
+    (``<name>_retry_attempts_total``, default
+    ``pipeline_connect_retry_attempts_total``), so a worker flapping its
+    way up is visible, not silent — the pipeline recovery sweep passes
+    ``name="pipeline_reconnect"`` so a post-failure reconnect storm is
+    distinguishable from bootstrap dial-in. ``sleep``/``clock`` are
+    injectable for sleep-free tests."""
 
     def attempt() -> Channel:
         _faults.trip("comm.connect", host=host, port=port)
@@ -255,7 +265,7 @@ def connect(host: str, port: int, *, timeout: float = 60.0,
     try:
         return retry_call(attempt, attempts=attempts, base=delay, cap=2.0,
                           timeout=timeout, retry_on=(OSError,),
-                          sleep=sleep, clock=clock, name="pipeline_connect")
+                          sleep=sleep, clock=clock, name=name)
     except OSError as e:
         raise ConnectionError(f"cannot connect to {host}:{port} "
                               f"within {timeout}s: {e}") from e

@@ -21,21 +21,43 @@ Message flow (coordinator drives; see ``distributed_pipeline.py``):
                                                 improvement over the reference,
                                                 which ships the dead tensor)
   coordinator --UPDATE_PARAMETERS--> all; each acks PARAMETERS_UPDATED
+  coordinator --GATHER_WEIGHTS--> all; each replies WEIGHTS (params + state
+                                                + optimizer state blob — the
+                                                full-model commit material)
 
-Any exception in a handler is reported upstream as ERROR_REPORT with a
-traceback (reference ``pipeline_stage.hpp:276-282``) instead of silently
-dying; the coordinator raises it as :class:`PipelineWorkerError`.
+Liveness (ISSUE 13): every timeout here derives from the coordinator's
+:class:`~dcnn_tpu.parallel.distributed_pipeline.PipelineTimeouts` contract,
+shipped inside CONFIG_TRANSFER — ``heartbeat_s`` starts a background BEAT
+thread toward the coordinator, and ``coord_timeout_s`` bounds how long
+coordinator silence (the coordinator beats back) is tolerated before the
+worker declares it dead, drops the channel, and **returns to listening**
+with its stage and weights intact: a restarted coordinator (or a brand new
+one) HELLOs in and re-deploys — a dead coordinator never strands a worker
+in a blocking wait (the old hardcoded ``inbox.get(timeout=60.0)`` is now
+the contract's ``idle_poll_s``, used only when liveness is off).
+
+Failure semantics: any exception in a handler is reported upstream as
+ERROR_REPORT with a traceback (reference ``pipeline_stage.hpp:276-282``);
+:class:`~dcnn_tpu.resilience.faults.InjectedCrash` from the armed
+``pipeline.stage_death`` trip point (fired per dispatched job, so tests
+kill a stage at an exact point mid-batch) is NOT reported — it simulates
+SIGKILL: the serve loop unwinds, the ``finally`` closes every socket, and
+peers observe exactly a dead process.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 import traceback
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..resilience import faults as _faults
 from .comm import Channel, Inbox, connect, listen, parse_addr
+from .distributed_pipeline import _unpack_weights
 from .pipeline import PipelineStage
 
 
@@ -46,22 +68,65 @@ def _leaves_to_tree(template, leaves):
 
 class StageWorker:
     """Event loop around one :class:`PipelineStage` (reference
-    ``pipeline_stage.hpp:69-197`` message_loop / process_message)."""
+    ``pipeline_stage.hpp:69-197`` message_loop / process_message).
 
-    def __init__(self, port: int, compress: bool = False):
+    Timeout contract: the worker ships with NO local timeout policy —
+    ``heartbeat_s`` / ``coord_timeout_s`` arrive in CONFIG_TRANSFER from
+    the coordinator's ``PipelineTimeouts``, so exactly one knob set
+    configures both ends. ``idle_poll_s`` (constructor) is only the inbox
+    poll granularity before any coordinator has configured liveness.
+    """
+
+    def __init__(self, port: int, compress: bool = False, *,
+                 listen_sock=None, idle_poll_s: float = 60.0,
+                 fault_plan: Optional[_faults.FaultPlan] = None,
+                 clock=time.monotonic):
         self.port = port
         self.compress = compress
         self.inbox = Inbox()
         self.stage: Optional[PipelineStage] = None
-        self.coord: Optional[Channel] = None
         self.next: Optional[Channel] = None
         self.prev: Optional[Channel] = None
-        self.stage_id = -1
         self.is_first = False
         self.is_last = False
-        self.gen = 0          # batch generation; ABORT bumps it, stale jobs drop
         self._running = False
-        self._srv = None
+        self._srv = listen_sock
+        self._idle_poll_s = idle_poll_s
+        self._faults_plan = fault_plan
+        self._clock = clock
+        self._state_snap = None       # batch-start layer state, for ABORT
+        self._applied_batch = 0       # last UPDATE_PARAMETERS batch vintage
+        self._layers = None           # [start, end) range this stage holds
+        # shared with the beat thread + comm reader on_close callbacks
+        self._lock = threading.Lock()
+        self.coord: Optional[Channel] = None   # dcnn: guarded_by=_lock
+        self.stage_id = -1                     # dcnn: guarded_by=_lock
+        self.gen = 0                           # dcnn: guarded_by=_lock
+        self._hb_s = 0.0                       # dcnn: guarded_by=_lock
+        self._coord_timeout_s = 0.0            # dcnn: guarded_by=_lock
+        self._coord_heard = 0.0                # dcnn: guarded_by=_lock
+        self._coord_lost = False               # dcnn: guarded_by=_lock
+        self._beat_stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+
+    # -- plumbing ----------------------------------------------------------
+    def _trip(self, point: str, **ctx) -> None:
+        if self._faults_plan is not None:
+            self._faults_plan.trip(point, **ctx)
+        else:
+            _faults.trip(point, **ctx)
+
+    def _coord_chan(self) -> Optional[Channel]:
+        with self._lock:
+            return self.coord
+
+    def _gen_now(self) -> int:
+        with self._lock:
+            return self.gen
+
+    def _sid(self) -> int:
+        with self._lock:
+            return self.stage_id
 
     # -- connection intake --
     def _accept_loop(self) -> None:
@@ -71,45 +136,190 @@ class StageWorker:
             except OSError:
                 return
             chan = Channel(sock, compress=self.compress)
-            self.inbox.attach(chan)
+            self.inbox.attach(chan, on_close=self._on_chan_close)
 
+    def _on_chan_close(self, chan: Channel) -> None:
+        with self._lock:
+            if chan is self.coord:
+                self._coord_lost = True
+
+    # -- coordinator liveness ---------------------------------------------
+    def _check_coordinator(self, drained: bool = True) -> None:
+        """Convict a dead coordinator: its connection closed
+        (``_coord_lost``) or its BEATs stopped for ``coord_timeout_s``.
+        The worker drops the channel but KEEPS its stage + weights and
+        returns to listening — a respawned coordinator re-deploys (and
+        can even gather this stage's live weights back).
+
+        Silence is only judged when the inbox is DRAINED (the elastic
+        ``_recv`` rule): a long dispatch — the first job after a
+        (re)deploy pays the stage's XLA compile — leaves the
+        coordinator's BEATs queued unread, and timing it out before
+        consuming them would convict a healthy coordinator and loop the
+        run through pointless recoveries. Close-based conviction
+        (``_coord_lost``) stays immediate."""
+        ch = None
+        with self._lock:
+            if self.coord is None:
+                return
+            lost = self._coord_lost
+            if not lost and drained and self._hb_s > 0 \
+                    and self._coord_timeout_s > 0 \
+                    and self._clock() - self._coord_heard \
+                    > self._coord_timeout_s:
+                lost = True
+            if lost:
+                ch, self.coord = self.coord, None
+                self._coord_lost = False
+        if ch is not None:
+            ch.close()
+
+    def _poll_s(self) -> float:
+        with self._lock:
+            hb = self._hb_s
+        return min(hb, 1.0) if hb > 0 else self._idle_poll_s
+
+    def _start_beat(self, hb_s: float) -> None:
+        with self._lock:
+            self._hb_s = float(hb_s)
+        if self._beat_thread is not None or hb_s <= 0:
+            return
+        # fresh Event per thread: a worker re-serving after a stop must
+        # actually beat again (_stop_beat set the old one)
+        self._beat_stop = threading.Event()
+        stop = self._beat_stop
+
+        def loop() -> None:
+            first = True
+            while first or not stop.wait(hb_s):
+                first = False
+                with self._lock:
+                    coord, sid, gen = self.coord, self.stage_id, self.gen
+                if coord is None:
+                    continue
+                try:
+                    coord.send("BEAT", {"stage_id": sid, "gen": gen},
+                               attempts=1)
+                except OSError:
+                    pass  # the reader's on_close convicts the coordinator
+        self._beat_thread = threading.Thread(
+            target=loop, daemon=True, name=f"dcnn-pipe-beat-{self.port}")
+        self._beat_thread.start()
+
+    def _stop_beat(self) -> None:
+        self._beat_stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=5.0)
+            self._beat_thread = None
+
+    # -- lifecycle ---------------------------------------------------------
     def serve(self) -> None:
-        """Listen and process messages until SHUTDOWN. Blocking."""
-        import threading
-
-        self._srv = listen(self.port)
+        """Listen and process messages until SHUTDOWN/:meth:`stop`.
+        Blocking."""
+        if self._srv is None:
+            self._srv = listen(self.port)
+        self.port = self._srv.getsockname()[1]
         self._running = True
         acceptor = threading.Thread(target=self._accept_loop, daemon=True)
         acceptor.start()
         try:
             while self._running:
+                # close-based conviction is immediate; the time-based one
+                # waits for a drained inbox (the TimeoutError branch)
+                self._check_coordinator(drained=False)
                 try:
-                    cmd, meta, payload, chan = self.inbox.get(timeout=60.0)
+                    cmd, meta, payload, chan = self.inbox.get(
+                        timeout=self._poll_s())
                 except TimeoutError:
+                    self._check_coordinator(drained=True)
                     continue  # idle is not an error — keep serving
+                with self._lock:
+                    if chan is self.coord:
+                        self._coord_heard = self._clock()
+                if cmd in ("BEAT", "_STOP"):
+                    continue
                 try:
                     self._dispatch(cmd, meta, payload, chan)
+                except _faults.InjectedCrash:
+                    # the SIGKILL stand-in: never reported upstream — the
+                    # finally below closes every socket, which is exactly
+                    # what a dead process's kernel does
+                    raise
                 except Exception:  # noqa: BLE001 — reported, not fatal
-                    err = {"stage_id": self.stage_id, "gen": meta.get("gen"),
+                    err = {"stage_id": self._sid(), "gen": meta.get("gen"),
                            "error": traceback.format_exc()}
-                    if self.coord is not None:
-                        self.coord.send("ERROR_REPORT", err)
+                    coord = self._coord_chan()
+                    if coord is not None:
+                        try:
+                            coord.send("ERROR_REPORT", err)
+                        except OSError:
+                            pass
         finally:
             self._running = False
+            self._close_all()
+            self._stop_beat()
+
+    def _shutdown_listener(self) -> None:
+        """``shutdown()`` then close the listener: the acceptor thread
+        blocked in ``accept()`` otherwise keeps the fd (and the kernel's
+        listen queue) alive, so a 'dead' worker's port would keep
+        completing handshakes and a recovery sweep would respawn-connect
+        to a zombie (the PR-9 ReplicaServer lesson)."""
+        import socket as _socket
+        if self._srv is None:
+            return
+        try:
+            self._srv.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
-            for c in (self.coord, self.next, self.prev):
-                if c is not None:
-                    c.close()
+        except OSError:
+            pass
+
+    def _close_all(self) -> None:
+        """Close every socket the worker owns, listener first — what a
+        dead process's kernel would do, so peers observe exactly a
+        death."""
+        self._shutdown_listener()
+        with self._lock:
+            coord, self.coord = self.coord, None
+        for c in (coord, self.next, self.prev):
+            if c is not None:
+                c.close()
+
+    def stop(self) -> None:
+        """Thread-safe external stop: wakes the serve loop promptly (an
+        internal no-op frame) instead of waiting out an idle poll."""
+        self._running = False
+        self._shutdown_listener()
+        self.inbox.post("_STOP")
 
     # -- dispatch (reference process_message switch, pipeline_stage.hpp:95) --
     def _dispatch(self, cmd: str, meta: Dict[str, Any], payload: Any,
                   chan: Channel) -> None:
+        if cmd in ("FORWARD_JOB", "BACKWARD_JOB", "UPDATE_PARAMETERS",
+                   "CONFIG_TRANSFER", "GATHER_WEIGHTS"):
+            # the kill-a-stage fault point: fired per dispatched job (a
+            # deterministic sequence, unlike the timer-driven beats), so a
+            # test's ``at=k`` lands on an exact microbatch / the recovery
+            # re-ship (CONFIG_TRANSFER) for the double-fault matrix
+            self._trip("pipeline.stage_death", cmd=cmd,
+                       mb=meta.get("mb_id"), stage=self._sid())
+
         if cmd == "HELLO":
             role = meta["role"]
             if role == "coordinator":
-                self.coord = chan
+                with self._lock:
+                    old, self.coord = self.coord, chan
+                    self._coord_heard = self._clock()
+                    self._coord_lost = False
+                if old is not None and old is not chan:
+                    old.close()
             elif role == "prev_stage":
-                self.prev = chan
+                old, self.prev = self.prev, chan
+                if old is not None and old is not chan:
+                    old.close()
             return
 
         if cmd == "CONFIG_TRANSFER":
@@ -117,20 +327,26 @@ class StageWorker:
             return
 
         if cmd in ("FORWARD_JOB", "BACKWARD_JOB") and \
-                meta.get("gen", 0) < self.gen:
+                meta.get("gen", 0) < self._gen_now():
             return  # stale job from an aborted batch — drop silently
 
         if cmd == "FORWARD_JOB":
             mb_id = meta["mb_id"]
             # legacy uint32 key layout — the framework's PRNGKey convention
             rng = jax.numpy.asarray(np.asarray(meta["rng"], np.uint32))
+            training = meta.get("training", True)
+            if training and not self.stage.batch_open():
+                # batch start: snapshot layer state so ABORT can roll back
+                # BN running stats mutated by this batch's forwards
+                self._state_snap = self.stage.snapshot_state()
             y = self.stage.forward(mb_id, np.asarray(payload), rng,
-                                   training=meta.get("training", True))
+                                   training=training)
             out = np.asarray(y)
             if self.is_last:
-                self.coord.send("FORWARD_RESULT",
-                                {"mb_id": mb_id, "gen": meta.get("gen", 0)},
-                                array=out)
+                self._coord_chan().send(
+                    "FORWARD_RESULT",
+                    {"mb_id": mb_id, "gen": meta.get("gen", 0)},
+                    array=out)
             else:
                 self.next.send("FORWARD_JOB", dict(meta), array=out)
             return
@@ -139,8 +355,9 @@ class StageWorker:
             mb_id = meta["mb_id"]
             xgrad = self.stage.backward(mb_id, np.asarray(payload))
             if self.is_first:
-                self.coord.send("BACKWARD_DONE",
-                                {"mb_id": mb_id, "gen": meta.get("gen", 0)})
+                self._coord_chan().send(
+                    "BACKWARD_DONE",
+                    {"mb_id": mb_id, "gen": meta.get("gen", 0)})
             else:
                 self.prev.send("BACKWARD_JOB",
                                {"mb_id": mb_id, "gen": meta.get("gen", 0)},
@@ -149,54 +366,79 @@ class StageWorker:
 
         if cmd == "UPDATE_PARAMETERS":
             self.stage.apply_updates(meta["lr"])
-            self.coord.send("PARAMETERS_UPDATED", {"stage_id": self.stage_id})
+            self._applied_batch = int(meta.get("batch",
+                                               self._applied_batch + 1))
+            self._state_snap = None  # batch committed — nothing to roll back
+            # gen echo: an ack lingering across a recovery's generation
+            # bump must never satisfy the NEW generation's update join
+            self._coord_chan().send("PARAMETERS_UPDATED",
+                                    {"stage_id": self._sid(),
+                                     "gen": self._gen_now()})
+            return
+
+        if cmd == "GATHER_WEIGHTS":
+            # the coordinator's full-model commit material (checkpoint
+            # cadence) / recovery gather: live weights + optimizer state,
+            # stamped with the batch vintage so a mid-update death is
+            # detected as a mixed-vintage gather and restored instead
+            self._handle_gather(meta)
             return
 
         if cmd == "LOAD_REPORT_REQUEST":
-            self.coord.send("LOAD_REPORT", {"stage_id": self.stage_id,
-                                            "report": self.stage.load.report()})
+            self._coord_chan().send(
+                "LOAD_REPORT", {"stage_id": self._sid(),
+                                "report": self.stage.load.report()})
             return
 
         if cmd == "PRINT_PROFILING":
             # per-layer fwd/bwd µs table (reference PRINT_PROFILING
             # broadcast, coordinator.hpp:384-403 / pipeline_stage.hpp:138-159);
             # the echoed nonce lets the coordinator fence stale replies
-            self.coord.send("PROFILING_REPORT",
-                            {"stage_id": self.stage_id,
-                             "nonce": meta.get("nonce"),
-                             "profile": self.stage.collect_profile()})
+            self._coord_chan().send(
+                "PROFILING_REPORT",
+                {"stage_id": self._sid(),
+                 "nonce": meta.get("nonce"),
+                 "profile": self.stage.collect_profile()})
             return
 
         if cmd == "CLEAR_PROFILING":
             self.stage.clear_profile()
-            self.coord.send("PROFILING_CLEARED", {"stage_id": self.stage_id,
-                                                  "nonce": meta.get("nonce")})
+            self._coord_chan().send(
+                "PROFILING_CLEARED", {"stage_id": self._sid(),
+                                      "nonce": meta.get("nonce")})
             return
 
         if cmd == "HEALTH_CHECK":
-            # liveness + basic vitals (the reference reserves HEALTH_CHECK in
-            # its CommandType enum, command_type.hpp:20-68, without wiring
-            # it; here it is a real coordinator-driven heartbeat)
+            # liveness + basic vitals; also the coordinator's
+            # probe-then-convict probe (nonce "probe" — the echo refreshes
+            # last-heard, then gets dropped by the nonce fence)
             from ..utils.hardware import get_memory_usage_kb
-            self.coord.send("HEALTH_ACK", {
-                "stage_id": self.stage_id,
+            self._coord_chan().send("HEALTH_ACK", {
+                "stage_id": self._sid(),
                 "nonce": meta.get("nonce"),
                 "configured": self.stage is not None,
-                "gen": self.gen,
+                "gen": self._gen_now(),
+                "batch": self._applied_batch,
                 "rss_kb": get_memory_usage_kb(),
             })
             return
 
         if cmd == "ABORT":
-            # clean abort: drop residuals + accumulated grads so the next
-            # batch starts consistent (VERDICT r1 weak #5); the new
-            # generation fences out any in-flight jobs from the dead batch
-            self.gen = meta.get("gen", self.gen + 1)
+            # clean abort: drop residuals + accumulated grads AND roll
+            # back layer state (BN running stats) to batch start so the
+            # next batch — or a recovery's weight gather — sees exactly
+            # the post-last-update state; the new generation fences out
+            # any in-flight jobs from the dead batch
+            with self._lock:
+                self.gen = meta.get("gen", self.gen + 1)
             if self.stage is not None:
-                self.stage.clear_cache()
-                self.stage.reset_gradients()
-            self.coord.send("ABORTED", {"stage_id": self.stage_id,
-                                        "gen": self.gen})
+                if self._state_snap is not None:
+                    self.stage.abort(self._state_snap)
+                else:
+                    self.stage.abort()
+                self._state_snap = None
+            self._coord_chan().send("ABORTED", {"stage_id": self._sid(),
+                                                "gen": self._gen_now()})
             return
 
         if cmd == "SHUTDOWN":
@@ -208,32 +450,78 @@ class StageWorker:
     # -- CONFIG_TRANSFER (reference handle_configuration,
     #    pipeline_stage.hpp:231-289) --
     def _handle_configuration(self, meta: Dict[str, Any], payload: Any) -> None:
-        self.stage_id = meta["stage_id"]
+        with self._lock:
+            self.stage_id = meta["stage_id"]
+            # adopt the shipping generation: recovery re-ships carry the
+            # post-abort gen, fencing any stragglers of the dead batch
+            self.gen = int(meta.get("gen", self.gen))
         self.is_first = meta["is_first"]
         self.is_last = meta["is_last"]
         self.stage = PipelineStage.from_config(
-            self.stage_id, meta["model"], meta["optimizer"],
+            meta["stage_id"], meta["model"], meta["optimizer"],
             track_load=meta.get("track_load", False))
+        self._state_snap = None
+        self._applied_batch = int(meta.get("batch", 0))
+        self._layers = meta.get("layers")
 
-        # weights arrive as one npz blob; rebuild pytrees against the
-        # stage model's own init structure (same layer code ⇒ same treedef)
-        import io
-
-        npz = np.load(io.BytesIO(payload), allow_pickle=False)
-        n_params = int(npz["n_params"])
-        leaves = [npz[f"a{i}"] for i in range(len(npz.files) - 1)]
+        # weights arrive as one npz blob (params ‖ state ‖ optional
+        # optimizer state); rebuild pytrees against the stage model's own
+        # init structure (same layer code ⇒ same treedef). Optimizer state
+        # rides along on recovery re-ships so a repartition preserves
+        # momentum exactly.
+        pl, sl, ol = _unpack_weights(payload)
         tp, ts = self.stage.model.init(jax.random.PRNGKey(0))
-        params = _leaves_to_tree(tp, leaves[:n_params])
-        state = _leaves_to_tree(ts, leaves[n_params:])
-        self.stage.set_weights(params, state)
+        params = _leaves_to_tree(tp, pl)
+        state = _leaves_to_tree(ts, sl)
+        opt_state = (_leaves_to_tree(self.stage.optimizer.init(tp), ol)
+                     if ol else None)
+        self.stage.set_weights(params, state, opt_state)
 
+        # a re-deploy replaces the downstream chain: close the old next
+        # channel (its worker is being reconfigured too) and dial the new
+        if self.next is not None:
+            self.next.close()
+            self.next = None
         if meta.get("next_addr"):
             host, port = parse_addr(meta["next_addr"])
-            self.next = connect(host, port, compress=self.compress)
+            # dial budget from the coordinator's contract: a next hop that
+            # died between the coordinator's sweep and this dial must fail
+            # fast (→ ERROR_REPORT → the coordinator re-enters recovery),
+            # not wedge this worker through the next reconfiguration
+            self.next = connect(host, port, compress=self.compress,
+                                timeout=float(meta.get("connect_s", 60.0)))
             self.next.send("HELLO", {"role": "prev_stage"})
-            self.inbox.attach(self.next)
-        self.coord.send("CONFIG_RECEIVED", {"stage_id": self.stage_id})
+            self.inbox.attach(self.next, on_close=self._on_chan_close)
+
+        # the coordinator's timeout contract, one source of truth for
+        # both ends (PipelineTimeouts): BEAT cadence + its own conviction
+        self._start_beat(float(meta.get("heartbeat_s", 0.0)))
+        with self._lock:
+            self._coord_timeout_s = float(meta.get("coord_timeout_s", 0.0))
+            self._coord_heard = self._clock()
+        self._coord_chan().send("CONFIG_RECEIVED",
+                                {"stage_id": self._sid(),
+                                 "gen": self._gen_now()})
+
+    def _handle_gather(self, meta: Dict[str, Any]) -> None:
+        from .distributed_pipeline import _pack_weights
+
+        coord = self._coord_chan()
+        st = self.stage
+        if st is None or st.params is None:
+            coord.send("WEIGHTS", {"stage_id": self._sid(),
+                                   "nonce": meta.get("nonce"),
+                                   "configured": False})
+            return
+        blob = _pack_weights(jax.device_get(st.params),
+                             jax.device_get(st.state),
+                             jax.device_get(st.opt_state))
+        coord.send("WEIGHTS", {"stage_id": self._sid(),
+                               "nonce": meta.get("nonce"),
+                               "configured": True,
+                               "batch": self._applied_batch,
+                               "layers": self._layers}, raw=blob)
 
 
-def run_worker(port: int, compress: bool = False) -> None:
-    StageWorker(port, compress=compress).serve()
+def run_worker(port: int, compress: bool = False, **kw) -> None:
+    StageWorker(port, compress=compress, **kw).serve()

@@ -53,6 +53,19 @@ Trip points wired in this PR (grep for ``faults.trip`` to enumerate):
                                 ``exc=InjectedCrash`` on a *second* peer it
                                 proves a loss during recovery is survived
                                 (reconfigure idempotence)
+``pipeline.stage_death``        raise in a TCP stage worker's dispatch path at
+                                job ``at=k`` (a deterministic per-worker
+                                sequence: FORWARD/BACKWARD/UPDATE/CONFIG/
+                                GATHER) — armed with ``exc=InjectedCrash``
+                                this IS the kill-a-stage-mid-batch
+                                simulation: the worker's sockets close and
+                                the coordinator recovers
+                                (``parallel/worker.py``)
+``pipeline.weight_ship``        fail the coordinator's recovery weight
+                                re-ship for stage ``at=i`` — armed with
+                                ``exc=OSError`` it is the torn-weight-ship
+                                simulation; recovery re-enters idempotently
+                                (``parallel/distributed_pipeline.py``)
 ``serve.route``                 fail the router's admission/dispatch path for
                                 request ``at=i`` (``serve/router.py``) — the
                                 routing-layer-itself chaos hook

@@ -202,9 +202,18 @@ class MetaCompressor:
     def compress_array(self, arr: np.ndarray,
                        codec: Optional[Compressor] = None) -> bytes:
         arr = np.ascontiguousarray(arr)
+        # extension dtypes (jax's bf16 compute dtype, DCNN_PRECISION=bf16)
+        # have no 4-char numpy descr — without the explicit tag the
+        # truncated descr decoded as 2-byte void and the pipeline wire
+        # silently corrupted bf16 activations
+        if arr.dtype.name == "bfloat16":
+            descr = b"bf16"
+        else:
+            descr = np.lib.format.dtype_to_descr(
+                arr.dtype).encode()[:4].ljust(4)
         header = struct.pack("<B", arr.ndim) + \
             b"".join(struct.pack("<Q", d) for d in arr.shape) + \
-            struct.pack("<4s", np.lib.format.dtype_to_descr(arr.dtype).encode()[:4].ljust(4))
+            struct.pack("<4s", descr)
         return self.compress(header + arr.tobytes(), codec)
 
     def decompress_array(self, blob: bytes) -> np.ndarray:
@@ -217,4 +226,9 @@ class MetaCompressor:
             off += 8
         descr = struct.unpack_from("<4s", raw, off)[0].decode().strip("\x00").strip()
         off += 4
-        return np.frombuffer(raw[off:], dtype=np.dtype(descr)).reshape(shape)
+        if descr == "bf16":
+            import ml_dtypes
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = np.dtype(descr)
+        return np.frombuffer(raw[off:], dtype=dtype).reshape(shape)

@@ -22,6 +22,12 @@
 #      — all with zero baseline entries. The tracer's context plumbing
 #      keeps the disabled-path <100 ns no-op bound, asserted in
 #      tests/test_obs.py (propagation must cost nothing when off).
+#      The self-healing pipeline pair parallel/distributed_pipeline.py +
+#      parallel/worker.py is covered the same way: CC01 guarded_by
+#      discipline on the coordinator's liveness tables and the worker's
+#      beat-visible state, CC02 on both beat threads (daemon +
+#      stop-event + joined in shutdown()/serve()'s finally) — zero new
+#      baseline entries.
 #   3. benchmarks/compare.py --self-test — the bench regression gate's own
 #      fixture run (planted 25% drop must flag; clean history must pass).
 #
