@@ -80,7 +80,7 @@ def run() -> dict:
             oh = got.shape[2]
             flops = 2.0 * batch * cout * cin * k * k * oh * oh
             dt, _ = time_chained(
-                lambda xx, ww: fwd(xx, ww, stride=s, padding=p),
+                lambda xx, ww, _s=s, _p=p: fwd(xx, ww, stride=_s, padding=_p),
                 (dx, dw), dep_feed(0), length=length)
             results.append(Result(f"conv_fwd_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))
@@ -90,16 +90,16 @@ def run() -> dict:
             # autodiff oracle for the explicit backward kernels (same-device,
             # parity precision) — these are distinct code paths in ops/conv.py
             set_precision("parity")
-            _, vjp = jax.vjp(lambda xx, ww: conv_ops.conv2d(
-                xx, ww, stride=s, padding=p, data_format="NCHW"), dx, dw)
+            _, vjp = jax.vjp(lambda xx, ww, _s=s, _p=p: conv_ops.conv2d(
+                xx, ww, stride=_s, padding=_p, data_format="NCHW"), dx, dw)
             want_ig, want_wg = jax.device_get(vjp(dg))
             set_precision(mode)
 
             got_wg = wgrad(dx, dg, kernel_hw=(k, k), stride=s, padding=p)
             ok, err = check_match(got_wg, want_wg, TOLS[mode])
             dt, _ = time_chained(
-                lambda xx, gg: wgrad(xx, gg, kernel_hw=(k, k), stride=s,
-                                     padding=p),
+                lambda xx, gg, _k=k, _s=s, _p=p: wgrad(
+                    xx, gg, kernel_hw=(_k, _k), stride=_s, padding=_p),
                 (dx, dg), dep_feed(0), length=length)
             results.append(Result(f"conv_wgrad_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))
@@ -107,8 +107,8 @@ def run() -> dict:
             got_ig = igrad(dw, dg, input_shape=x.shape, stride=s, padding=p)
             ok, err = check_match(got_ig, want_ig, TOLS[mode])
             dt, _ = time_chained(
-                lambda ww, gg: igrad(ww, gg, input_shape=x.shape, stride=s,
-                                     padding=p),
+                lambda ww, gg, _s=s, _p=p: igrad(
+                    ww, gg, input_shape=x.shape, stride=_s, padding=_p),
                 (dw, dg), dep_feed(0), length=length)
             results.append(Result(f"conv_igrad_{tag}", dt, flops / dt / 1e12,
                                   "TFLOP/s", ok, err))

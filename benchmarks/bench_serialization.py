@@ -68,8 +68,9 @@ def run() -> dict:
     for pname, arr in payloads.items():
         nbytes = arr.nbytes
         for cname, codec in codecs.items():
-            dt_c, blob = _time_host(lambda: mc.compress_array(arr, codec))
-            dt_d, back = _time_host(lambda: mc.decompress_array(blob))
+            dt_c, blob = _time_host(
+                lambda a=arr, c=codec: mc.compress_array(a, c))
+            dt_d, back = _time_host(lambda b=blob: mc.decompress_array(b))
             ok = (back.dtype == arr.dtype and back.shape == arr.shape
                   and np.array_equal(back, arr))
             results.append(Result(

@@ -42,7 +42,53 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the check-id table and exit")
     p.add_argument("--show-suppressed", action="store_true",
                    help="include suppressed findings in the text output")
+    p.add_argument("--only", default=None, metavar="PATHS",
+                   help="comma-separated display paths: analyze the full "
+                        "paths given, report findings only for these "
+                        "files (tools/check.sh --changed-only — keeps "
+                        "whole-project checks like DL01/PR01 accurate "
+                        "while scoping the report)")
+    p.add_argument("--fault-coverage", action="store_true",
+                   help="FC01: every fault trip point referenced in the "
+                        "package must be armed by a test under --tests")
+    p.add_argument("--metric-drift", action="store_true",
+                   help="MD01: emitted obs.registry metric names and "
+                        "docs/observability.md must agree, both ways")
+    p.add_argument("--tests", default="tests",
+                   help="tests directory for --fault-coverage "
+                        "(default: tests)")
+    p.add_argument("--doc", default=os.path.join("docs",
+                                                 "observability.md"),
+                   help="metric documentation for --metric-drift")
     return p
+
+
+def _run_lints(args) -> int:
+    """The cross-directory coverage lints (FC01/MD01). The package dir is
+    the first positional path."""
+    from .core import load_project
+    from .coverage import check_fault_coverage, check_metric_drift
+
+    pkg = args.paths[0] if args.paths else "dcnn_tpu"
+    project = load_project([pkg])  # parsed once, shared by both lints
+    findings = []
+    if args.fault_coverage:
+        findings += check_fault_coverage(pkg, args.tests, project=project)
+    if args.metric_drift:
+        findings += check_metric_drift(pkg, args.doc, project=project)
+    if args.only:
+        scope = {s.strip().replace(os.sep, "/")
+                 for s in args.only.split(",") if s.strip()}
+        findings = [f for f in findings if f.path in scope]
+    live = [f for f in findings if not f.suppressed]
+    if args.json:
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "unsuppressed": len(live)}, indent=2))
+    else:
+        for f in (findings if args.show_suppressed else live):
+            print(f.render())
+        print(f"{len(live)} finding(s)")
+    return 1 if live else 0
 
 
 def main(argv=None) -> int:
@@ -55,6 +101,8 @@ def main(argv=None) -> int:
         if not os.path.exists(p):
             print(f"error: no such path {p!r}", file=sys.stderr)
             return 2
+    if args.fault_coverage or args.metric_drift:
+        return _run_lints(args)
     checks = ([c.strip() for c in args.checks.split(",") if c.strip()]
               if args.checks else None)
     baseline = Baseline() if args.no_baseline else Baseline.load(
@@ -67,6 +115,16 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     wall = time.perf_counter() - t0
+    if args.only:
+        if args.write_baseline:
+            # a baseline rendered from a filtered report would silently
+            # drop every out-of-scope accepted finding
+            print("error: --only cannot be combined with "
+                  "--write-baseline", file=sys.stderr)
+            return 2
+        scope = {s.strip().replace(os.sep, "/")
+                 for s in args.only.split(",") if s.strip()}
+        findings = [f for f in findings if f.path in scope]
     live = unsuppressed(findings)
     if args.write_baseline:
         # dogfood the committed-artifact discipline this suite enforces

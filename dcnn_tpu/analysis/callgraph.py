@@ -71,12 +71,135 @@ def is_self_call(func: ast.AST) -> Optional[str]:
 FuncKey = Tuple[str, str]  # (module path, qualname)
 
 
+class ClassIndex:
+    """Cross-class attribute typing: which project class does
+    ``self.<attr>`` (or a module-level/local binding) hold an instance
+    of? Resolution is name-based over ``self.x = ClassName(...)``
+    assignments (and ``mod.ClassName(...)`` by trailing name) — the one
+    inference step that turns ``self.chan.send(...)`` into an edge into
+    ``Channel.send`` for the lock/protocol/blocking analyses."""
+
+    def __init__(self, project: Dict[str, SourceModule]):
+        self.project = project
+        self._local_cache: Dict[int, Dict[str, str]] = {}
+        # class name -> [(module path, ClassDef)]
+        self.classes: Dict[str, List[Tuple[str, ast.ClassDef]]] = {}
+        for path, mod in project.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, []).append(
+                        (path, node))
+        # (module path, class name) -> {attr -> attr's class name}
+        self.attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # module path -> {module-level name -> class name}
+        self.global_types: Dict[str, Dict[str, str]] = {}
+        for path, mod in project.items():
+            self.global_types[path] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.AnnAssign):
+                    # ``self.coord: Optional[Channel] = None`` — the
+                    # annotation types the attribute even when the value
+                    # doesn't (the deferred-construction idiom)
+                    t = node.target
+                    cname = self._annotation_class(node.annotation)
+                    if (cname is not None and isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        cls = mod.enclosing_class(node)
+                        if cls is not None:
+                            self.attr_types.setdefault(
+                                (path, cls.name), {})[t.attr] = cname
+                    continue
+                if not isinstance(node, ast.Assign):
+                    continue
+                cname = self._ctor_name(node.value)
+                if cname is None:
+                    # ``self.x = param`` where the enclosing function
+                    # annotates ``param`` with a project class
+                    cname = self._param_class(mod, node)
+                if cname is None:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        cls = mod.enclosing_class(node)
+                        if cls is not None:
+                            self.attr_types.setdefault(
+                                (path, cls.name), {})[t.attr] = cname
+                    elif isinstance(t, ast.Name) and isinstance(
+                            mod.parents.get(node), ast.Module):
+                        self.global_types[path][t.id] = cname
+
+    def _ctor_name(self, value: ast.AST) -> Optional[str]:
+        """``Foo(...)`` / ``pkg.Foo(...)`` -> ``Foo`` iff Foo is a class
+        defined somewhere in the project."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = call_name(value.func)
+        if name in self.classes:
+            return name
+        return None
+
+    def _annotation_class(self, ann: Optional[ast.AST]) -> Optional[str]:
+        """First project-class name mentioned anywhere in an annotation
+        (``Channel``, ``Optional[Channel]``, ``"Channel"``)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str) \
+                and ann.value in self.classes:
+            return ann.value
+        for node in ast.walk(ann):
+            if isinstance(node, ast.Name) and node.id in self.classes:
+                return node.id
+            if isinstance(node, ast.Attribute) and node.attr in self.classes:
+                return node.attr
+        return None
+
+    def _param_class(self, mod: SourceModule,
+                     assign: ast.Assign) -> Optional[str]:
+        if not isinstance(assign.value, ast.Name):
+            return None
+        fn = mod.enclosing_function(assign)
+        if fn is None:
+            return None
+        ann = self.param_annotation(fn, assign.value.id)
+        return self._annotation_class(ann)
+
+    @staticmethod
+    def param_annotation(fn, name: str) -> Optional[ast.AST]:
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            if arg.arg == name:
+                return arg.annotation
+        return None
+
+    def local_types(self, fn: ast.AST) -> Dict[str, str]:
+        """Names bound to project-class constructions inside ``fn``
+        (memoized — resolve_call asks per call site)."""
+        cached = self._local_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                cname = self._ctor_name(node.value)
+                if cname is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = cname
+        self._local_cache[id(fn)] = out
+        return out
+
+
 class FunctionIndex:
     """Every def in the project, plus the name maps the walk resolves
     against."""
 
     def __init__(self, project: Dict[str, SourceModule]):
         self.project = project
+        self.class_index = ClassIndex(project)
         self.functions: Dict[FuncKey, ast.FunctionDef] = {}
         # module -> bare name -> qualnames defined at module top level
         self.module_defs: Dict[str, Dict[str, List[str]]] = {}
@@ -106,6 +229,15 @@ class FunctionIndex:
                     for alias in node.names:
                         self.from_imports[path].add(alias.asname or alias.name)
 
+    def method_of(self, cname: str, mname: str) -> List[FuncKey]:
+        """Definitions of ``<cname>.<mname>`` across the project."""
+        out: List[FuncKey] = []
+        for cpath, _cls in self.class_index.classes.get(cname, []):
+            qn = self.methods.get((cpath, cname), {}).get(mname)
+            if qn is not None:
+                out.append((cpath, qn))
+        return out
+
     def resolve_call(self, path: str, caller: ast.FunctionDef,
                      func: ast.AST) -> List[FuncKey]:
         """Possible definitions a call target refers to."""
@@ -118,6 +250,27 @@ class FunctionIndex:
                 if qn is not None:
                     return [(path, qn)]
             return []
+        if isinstance(func, ast.Attribute):
+            # cross-class attribute resolution: self.<attr>.<m>() through
+            # the ClassIndex type map, <local>.<m>() through local ctor
+            # bindings, <GLOBAL>.<m>() through module-level bindings
+            recv = func.value
+            cname: Optional[str] = None
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                cls = mod.enclosing_class(caller)
+                if cls is not None:
+                    cname = self.class_index.attr_types.get(
+                        (path, cls.name), {}).get(recv.attr)
+            elif isinstance(recv, ast.Name):
+                cname = self.class_index.local_types(caller).get(recv.id) \
+                    or self.class_index.global_types.get(path, {}).get(
+                        recv.id) \
+                    or self.class_index._annotation_class(
+                        ClassIndex.param_annotation(caller, recv.id))
+            if cname is not None:
+                return self.method_of(cname, func.attr)
         if isinstance(func, ast.Name):
             name = func.id
             # nested def in the caller's own scope wins

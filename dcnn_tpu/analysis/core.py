@@ -38,6 +38,18 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
 
 _DISABLE_RE = re.compile(r"#\s*dcnn:\s*disable=([A-Za-z0-9_,\s-]+)")
 _GUARDED_RE = re.compile(r"#\s*dcnn:\s*guarded_by=([A-Za-z_][A-Za-z0-9_]*)")
+# protocol map annotations (PR01/PR02): declared like guarded_by —
+#   # dcnn: protocol=<name> role=sender
+#   # dcnn: protocol=<name> role=handler [frames=EXTRA,FRAMES|*]
+# attached to the innermost enclosing function; a bare
+# ``# dcnn: protocol=<name>`` on a send-call line rebinds that one send.
+_PROTOCOL_RE = re.compile(
+    r"#\s*dcnn:\s*protocol=([A-Za-z_][A-Za-z0-9_.-]*)"
+    r"(?:\s+role=(sender|handler))?"
+    r"(?:\s+frames=([A-Za-z0-9_,*]+))?")
+# metric-name declaration for dynamically-named instruments (the
+# metric-drift lint): ``reg.counter(name, ...)  # dcnn: metric=aot_*_total``
+_METRIC_RE = re.compile(r"#\s*dcnn:\s*metric=([A-Za-z0-9_,*]+)")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
@@ -96,6 +108,12 @@ class SourceModule:
         self.suppressions: Dict[int, Set[str]] = {}
         # guarded_by annotations: line -> lock attribute name
         self.guarded_by: Dict[int, str] = {}
+        # protocol annotations: line -> {"name", "role", "frames"}
+        # (role None = a line-scoped send rebinding; frames None = derive
+        # from the handler's own dispatch constants)
+        self.protocols: Dict[int, Dict[str, object]] = {}
+        # metric-name declarations: line -> [glob, ...]
+        self.metric_names: Dict[int, List[str]] = {}
         for i, text in enumerate(self.lines, start=1):
             m = _DISABLE_RE.search(text)
             if m:
@@ -104,6 +122,19 @@ class SourceModule:
             g = _GUARDED_RE.search(text)
             if g:
                 self.guarded_by[i] = g.group(1)
+            p = _PROTOCOL_RE.search(text)
+            if p:
+                frames = None
+                if p.group(3):
+                    frames = {f.strip() for f in p.group(3).split(",")
+                              if f.strip()}
+                self.protocols[i] = {"name": p.group(1),
+                                     "role": p.group(2), "frames": frames}
+            mm = _METRIC_RE.search(text)
+            if mm:
+                self.metric_names[i] = [t.strip()
+                                        for t in mm.group(1).split(",")
+                                        if t.strip()]
 
     # -- tree helpers --------------------------------------------------------
     def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
@@ -204,7 +235,8 @@ def register(check_id: str, name: str, description: str):
 
 def all_checks() -> Dict[str, Check]:
     # import for side effect: the families register themselves
-    from . import atomicity, concurrency, trace_safety  # noqa: F401
+    from . import (atomicity, concurrency, locks,  # noqa: F401
+                   protocol, retrace, trace_safety)
     return dict(_REGISTRY)
 
 

@@ -134,7 +134,12 @@ class Channel:
                     raise ChannelClosed(
                         "channel broken by an earlier partial send")
                 try:
-                    self._sock.sendall(frame)
+                    # deliberate blocking-send-under-lock: _send_lock
+                    # exists to serialize whole frames onto the socket —
+                    # the one place in the package where the blocking IO
+                    # IS the critical section. Callers must not hold
+                    # their own locks across send() (DL02 flags them).
+                    self._sock.sendall(frame)  # dcnn: disable=DL02
                 except OSError:
                     self._broken = True
                     raise

@@ -292,7 +292,7 @@ class DistributedPipelineCoordinator:
         return self._gen
 
     # -- deploy (reference deploy_stages, coordinator.hpp:456-514) --
-    def deploy_stages(self, key: jax.Array) -> None:
+    def deploy_stages(self, key: jax.Array) -> None:  # dcnn: protocol=pipe.c2w role=sender
         params, state = self.model.init(key)
         self._tpl_params, self._tpl_state = params, state
         opt0 = self.optimizer.init(params)
@@ -332,7 +332,8 @@ class DistributedPipelineCoordinator:
         self._reg.gauge("pipeline_generation",
                         "current pipeline batch generation").set(self._gen)
 
-    def _ship_stages(self, params, state, opt_state) -> None:
+    def _ship_stages(self, params, state,
+                     opt_state) -> None:  # dcnn: protocol=pipe.c2w role=sender
         """(Re)partition over the current worker set and ship stage
         configs + weights (+ optimizer state on a recovery re-ship — a
         repartition preserves momentum exactly via
@@ -416,7 +417,7 @@ class DistributedPipelineCoordinator:
                 self._last_heard[sid] = self._clock()
                 self._probe_at.pop(sid, None)
 
-    def _check_liveness(self) -> None:
+    def _check_liveness(self) -> None:  # dcnn: protocol=pipe.c2w role=sender
         """Probe-then-convict (the elastic/router pattern): silence past
         ``convict_s`` sends one HEALTH_CHECK probe; a probe unanswered for
         ``probe_s`` convicts. A closed connection (``_on_close``) or a
@@ -478,7 +479,7 @@ class DistributedPipelineCoordinator:
         self._beat_stop = threading.Event()
         stop = self._beat_stop
 
-        def loop() -> None:
+        def loop() -> None:  # dcnn: protocol=pipe.c2w role=sender
             while not stop.wait(self.t.heartbeat_s):
                 for ch in self._beat_targets():
                     try:
@@ -490,6 +491,7 @@ class DistributedPipelineCoordinator:
         self._beat_thread.start()
 
     # -- fenced receive: drops messages from aborted generations --
+    # dcnn: protocol=pipe.w2c role=handler
     def _recv(self) -> Tuple[str, Dict, Any]:
         clock = getattr(self, "_clock", time.monotonic)
         deadline = clock() + self.timeout
@@ -542,6 +544,12 @@ class DistributedPipelineCoordinator:
             if c in ("PROFILING_REPORT", "PROFILING_CLEARED") and \
                     meta.get("nonce") != getattr(self, "_profiling_nonce", None):
                 continue  # same staleness fence for profiling replies
+            if c == "LOAD_REPORT" and \
+                    meta.get("nonce") != getattr(self, "_load_nonce", None):
+                # straggler from a timed-out load-report round: an old
+                # reply satisfying a later join would hand the balancer
+                # a stale per-stage timing table (PR02 unfenced-stamp)
+                continue
             if c == "ERROR_REPORT":
                 self.abort()
                 raise PipelineWorkerError(meta.get("stage_id", -1),
@@ -592,6 +600,7 @@ class DistributedPipelineCoordinator:
         return self.num_stages - 1
 
     # -- schedules (mirror InProcessPipelineCoordinator) --
+    # dcnn: protocol=pipe.c2w role=sender
     def _send_forward(self, mb_id: int, x: np.ndarray, rng: jax.Array,
                       training: bool = True) -> None:
         key_data = (np.asarray(rng) if rng.dtype == np.uint32
@@ -633,6 +642,7 @@ class DistributedPipelineCoordinator:
             self._with_recovery(self._commit)
         return out
 
+    # dcnn: protocol=pipe.c2w role=sender
     def _batch_sync(self, x, y, lr, rng,
                     bno: Optional[int] = None) -> Tuple[float, np.ndarray]:
         from .pipeline import split_microbatches
@@ -658,6 +668,7 @@ class DistributedPipelineCoordinator:
         logits = np.concatenate([outputs[i] for i in range(len(mb_x))])
         return total_loss / x.shape[0], logits
 
+    # dcnn: protocol=pipe.c2w role=sender
     def _batch_semi_async(self, x, y, lr, rng,
                           bno: Optional[int] = None
                           ) -> Tuple[float, np.ndarray]:
@@ -702,6 +713,7 @@ class DistributedPipelineCoordinator:
         return self._with_recovery(run)
 
     # -- parameter update broadcast (coordinator.hpp:174-184) --
+    # dcnn: protocol=pipe.c2w role=sender
     def update_parameters(self, lr: float, batch: Optional[int] = None
                           ) -> None:
         for sid in range(self.num_stages):
@@ -712,15 +724,27 @@ class DistributedPipelineCoordinator:
         self._join("PARAMETERS_UPDATED", self.num_stages)
 
     # -- load reports (coordinator.hpp:331-379) --
-    def collect_load_reports(self) -> List[Dict[str, float]]:
-        for sid in range(self.num_stages):
-            self._send_stage(sid, "LOAD_REPORT_REQUEST", {})
-        got = self._join("LOAD_REPORT", self.num_stages)
+    def collect_load_reports(self) -> List[Dict[str, float]]:  # dcnn: protocol=pipe.c2w role=sender
+        """Nonce-fenced like the profiling/gather rounds: a LOAD_REPORT
+        straggler from a timed-out earlier round must never satisfy a
+        later join with a stale timing table."""
+        nonce = int.from_bytes(_os.urandom(4), "little")
+        self._load_nonce = nonce
+        try:
+            for sid in range(self.num_stages):
+                self._send_stage(sid, "LOAD_REPORT_REQUEST",
+                                 {"nonce": nonce})
+            got = self._join("LOAD_REPORT", self.num_stages,
+                             buffer_others=True)
+        finally:
+            self._load_nonce = None
         by_stage = {m["stage_id"]: m["report"] for m, _ in got}
         return [by_stage[i] for i in range(self.num_stages)]
 
     # -- per-layer profiling broadcast (coordinator.hpp:384-403) --
-    def _profiling_round(self, request: str, reply: str) -> List[Tuple[Dict, Any]]:
+    # dcnn: protocol=pipe.c2w role=sender frames=PRINT_PROFILING,CLEAR_PROFILING
+    def _profiling_round(self, request: str,
+                         reply: str) -> List[Tuple[Dict, Any]]:
         """One nonce-fenced broadcast/join: like HEALTH_CHECK, a reply from a
         timed-out earlier round must never satisfy a later join or leak into
         a batch join (``_recv`` drops ``reply`` messages whose nonce is not
@@ -747,7 +771,7 @@ class DistributedPipelineCoordinator:
         self._profiling_round("CLEAR_PROFILING", "PROFILING_CLEARED")
 
     # -- weight gather (the pipeline analog of elastic's shared commit) --
-    def _gather_stage_blobs(self) -> List[Tuple[Dict, Any]]:
+    def _gather_stage_blobs(self) -> List[Tuple[Dict, Any]]:  # dcnn: protocol=pipe.c2w role=sender
         """Nonce-fenced GATHER_WEIGHTS broadcast over the current
         channels; returns the WEIGHTS replies (meta carries stage_id /
         configured / batch vintage)."""
@@ -981,7 +1005,7 @@ class DistributedPipelineCoordinator:
         self._start_beat()
         self._replay_journal(from_batch)
 
-    def _rebuild_channels(self) -> List[Tuple[str, Channel]]:
+    def _rebuild_channels(self) -> List[Tuple[str, Channel]]:  # dcnn: protocol=pipe.c2w role=sender
         """Sweep the FULL original worker address list: reuse healthy
         channels, close + re-dial dead/dropped ones under the
         ``respawn_s`` budget (``pipeline_reconnect_retry_attempts_total``
@@ -1068,7 +1092,8 @@ class DistributedPipelineCoordinator:
                               "journaled batches re-run by recovery").inc()
 
     # -- failure handling --
-    def abort(self) -> None:
+    # dcnn: protocol=pipe.w2c role=handler frames=*
+    def abort(self) -> None:  # dcnn: protocol=pipe.c2w role=sender
         """Bump the batch generation (fencing out every in-flight message of
         the dead batch on both ends), broadcast cache/grad reset, drain
         ABORTED acks best-effort (``PipelineTimeouts.drain()`` budget,
@@ -1097,7 +1122,7 @@ class DistributedPipelineCoordinator:
             if cmd == "ABORTED" and meta.get("gen") == self._gen:
                 acked += 1
 
-    def health_check(self) -> List[Dict[str, Any]]:
+    def health_check(self) -> List[Dict[str, Any]]:  # dcnn: protocol=pipe.c2w role=sender
         """Heartbeat every worker (the HEALTH_CHECK command the reference
         reserves in its CommandType enum but never wires,
         command_type.hpp:20-68): returns one vitals dict per stage
@@ -1117,7 +1142,7 @@ class DistributedPipelineCoordinator:
         vitals = [meta for meta, _ in acks]
         return sorted(vitals, key=lambda v: v.get("stage_id", -1))
 
-    def shutdown(self) -> None:
+    def shutdown(self) -> None:  # dcnn: protocol=pipe.c2w role=sender
         self._beat_stop.set()
         if self._beat_thread is not None:
             self._beat_thread.join(timeout=5.0)

@@ -196,7 +196,8 @@ class Membership:
         self._hb_thread: Optional[threading.Thread] = None
 
     # -- bootstrap ---------------------------------------------------------
-    def connect_all(self, timeout: float = 60.0) -> None:
+    def connect_all(self, timeout: float = 60.0) -> None:  # dcnn: protocol=elastic.hello role=sender
+        # dcnn: protocol=elastic.hello role=handler
         """Establish the full mesh: dial every lower rank, accept every
         higher one (each pair has exactly one dialer), HELLO-stamp each
         connection so accepted sockets map to ranks."""
@@ -382,7 +383,7 @@ class Membership:
         with self._lock:
             self._beat_meta = dict(meta)
 
-    def beat_all(self) -> None:
+    def beat_all(self) -> None:  # dcnn: protocol=elastic.mesh role=sender frames=BEAT
         with self._lock:
             meta = dict(self._beat_meta)
         self.broadcast("BEAT", meta, attempts=1)
@@ -786,6 +787,7 @@ class ElasticController:
         self.membership.beat_all()
 
     # -- gradient exchange -------------------------------------------------
+    # dcnn: protocol=elastic.mesh role=sender
     def _exchange(self, flat: np.ndarray, loss_sum: float, local_mb: int,
                   gs: int) -> Tuple[np.ndarray, float]:
         """All-reduce of the flat (grad-sum ‖ scaled-state) vector over the
@@ -834,6 +836,7 @@ class ElasticController:
             match=lambda m: m.get("step") == gs)
         return payload, float(meta["loss"])
 
+    # dcnn: protocol=elastic.mesh role=handler frames=GRADS,GSUM,RECONF_ACK
     def _recv(self, want: Set[str], deadline: float, expect: Set[int],
               match: Optional[Callable[[Dict], bool]] = None,
               accept_reconf: bool = False):
@@ -997,6 +1000,7 @@ class ElasticController:
             self._reg.gauge("elastic_reconfiguring",
                             "1 while a reconfiguration is in flight").set(0)
 
+    # dcnn: protocol=elastic.mesh role=sender
     def _reconfigure_once(self, sig, gs: int
                           ) -> Tuple[TrainState, int, int, int]:
         self._trip("elastic.reconfigure", gen=self.gen)
@@ -1050,6 +1054,7 @@ class ElasticController:
         self._build(ts)
         return ts, epoch, step, new_gs
 
+    # dcnn: protocol=elastic.mesh role=sender
     def _join_reconf(self, meta: Dict[str, Any]
                      ) -> Tuple[TrainState, int, int, int]:
         """Adopt an established generation as a follower: restore the

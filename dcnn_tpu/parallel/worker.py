@@ -189,7 +189,7 @@ class StageWorker:
         self._beat_stop = threading.Event()
         stop = self._beat_stop
 
-        def loop() -> None:
+        def loop() -> None:  # dcnn: protocol=pipe.w2c role=sender
             first = True
             while first or not stop.wait(hb_s):
                 first = False
@@ -213,7 +213,7 @@ class StageWorker:
             self._beat_thread = None
 
     # -- lifecycle ---------------------------------------------------------
-    def serve(self) -> None:
+    def serve(self) -> None:  # dcnn: protocol=pipe.w2c role=sender
         """Listen and process messages until SHUTDOWN/:meth:`stop`.
         Blocking."""
         if self._srv is None:
@@ -296,8 +296,9 @@ class StageWorker:
         self.inbox.post("_STOP")
 
     # -- dispatch (reference process_message switch, pipeline_stage.hpp:95) --
+    # dcnn: protocol=pipe.c2w role=handler frames=BEAT,_STOP
     def _dispatch(self, cmd: str, meta: Dict[str, Any], payload: Any,
-                  chan: Channel) -> None:
+                  chan: Channel) -> None:  # dcnn: protocol=pipe.w2c role=sender
         if cmd in ("FORWARD_JOB", "BACKWARD_JOB", "UPDATE_PARAMETERS",
                    "CONFIG_TRANSFER", "GATHER_WEIGHTS"):
             # the kill-a-stage fault point: fired per dispatched job (a
@@ -322,7 +323,10 @@ class StageWorker:
                     old.close()
             return
 
-        if cmd == "CONFIG_TRANSFER":
+        # deliberate non-fence: a re-deploy ADOPTS the shipped gen (a
+        # respawned coordinator restarts its own gen counter, so a
+        # worker that refused lower gens could never be re-deployed)
+        if cmd == "CONFIG_TRANSFER":  # dcnn: disable=PR02
             self._handle_configuration(meta, payload)
             return
 
@@ -348,7 +352,8 @@ class StageWorker:
                     {"mb_id": mb_id, "gen": meta.get("gen", 0)},
                     array=out)
             else:
-                self.next.send("FORWARD_JOB", dict(meta), array=out)
+                self.next.send("FORWARD_JOB", dict(meta),
+                               array=out)  # dcnn: protocol=pipe.c2w
             return
 
         if cmd == "BACKWARD_JOB":
@@ -359,6 +364,7 @@ class StageWorker:
                     "BACKWARD_DONE",
                     {"mb_id": mb_id, "gen": meta.get("gen", 0)})
             else:
+                # dcnn: protocol=pipe.c2w
                 self.prev.send("BACKWARD_JOB",
                                {"mb_id": mb_id, "gen": meta.get("gen", 0)},
                                array=np.asarray(xgrad))
@@ -376,7 +382,9 @@ class StageWorker:
                                      "gen": self._gen_now()})
             return
 
-        if cmd == "GATHER_WEIGHTS":
+        # deliberate non-fence: the nonce is ECHOED (inside
+        # _handle_gather's WEIGHTS reply) for the coordinator to fence
+        if cmd == "GATHER_WEIGHTS":  # dcnn: disable=PR02
             # the coordinator's full-model commit material (checkpoint
             # cadence) / recovery gather: live weights + optimizer state,
             # stamped with the batch vintage so a mid-update death is
@@ -385,8 +393,11 @@ class StageWorker:
             return
 
         if cmd == "LOAD_REPORT_REQUEST":
+            # the echoed nonce lets the coordinator fence replies from a
+            # timed-out earlier round (the profiling-round pattern)
             self._coord_chan().send(
                 "LOAD_REPORT", {"stage_id": self._sid(),
+                                "nonce": meta.get("nonce"),
                                 "report": self.stage.load.report()})
             return
 
@@ -428,9 +439,17 @@ class StageWorker:
             # back layer state (BN running stats) to batch start so the
             # next batch — or a recovery's weight gather — sees exactly
             # the post-last-update state; the new generation fences out
-            # any in-flight jobs from the dead batch
+            # any in-flight jobs from the dead batch. Generations only
+            # ever advance: a straggler ABORT from an older recovery
+            # must not regress the fence (un-fencing that dead batch's
+            # in-flight jobs) or roll back state a newer generation
+            # already rebuilt — it is dropped, unacked (the old drain
+            # that wanted the ack has long moved on).
+            g = meta.get("gen")
             with self._lock:
-                self.gen = meta.get("gen", self.gen + 1)
+                if g is not None and g <= self.gen:
+                    return
+                self.gen = self.gen + 1 if g is None else int(g)
             if self.stage is not None:
                 if self._state_snap is not None:
                     self.stage.abort(self._state_snap)
@@ -449,7 +468,8 @@ class StageWorker:
 
     # -- CONFIG_TRANSFER (reference handle_configuration,
     #    pipeline_stage.hpp:231-289) --
-    def _handle_configuration(self, meta: Dict[str, Any], payload: Any) -> None:
+    def _handle_configuration(self, meta: Dict[str, Any],
+                              payload: Any) -> None:  # dcnn: protocol=pipe.w2c role=sender
         with self._lock:
             self.stage_id = meta["stage_id"]
             # adopt the shipping generation: recovery re-ships carry the
@@ -490,7 +510,7 @@ class StageWorker:
             # not wedge this worker through the next reconfiguration
             self.next = connect(host, port, compress=self.compress,
                                 timeout=float(meta.get("connect_s", 60.0)))
-            self.next.send("HELLO", {"role": "prev_stage"})
+            self.next.send("HELLO", {"role": "prev_stage"})  # dcnn: protocol=pipe.c2w
             self.inbox.attach(self.next, on_close=self._on_chan_close)
 
         # the coordinator's timeout contract, one source of truth for
@@ -503,7 +523,7 @@ class StageWorker:
                                 {"stage_id": self._sid(),
                                  "gen": self._gen_now()})
 
-    def _handle_gather(self, meta: Dict[str, Any]) -> None:
+    def _handle_gather(self, meta: Dict[str, Any]) -> None:  # dcnn: protocol=pipe.w2c role=sender
         from .distributed_pipeline import _pack_weights
 
         coord = self._coord_chan()

@@ -35,6 +35,7 @@ ours.
 from __future__ import annotations
 
 import threading
+import math
 import time
 import warnings
 from typing import Callable, Optional
@@ -92,7 +93,8 @@ class StepGuard:
         from ..obs.flight import resolve_flight_recorder
         return resolve_flight_recorder(self._flight)
 
-    def observe(self, step: int, bad: bool, loss: float = float("nan")) -> str:
+    def observe(self, step: int, bad: bool,
+                loss: float = math.nan) -> str:
         if not bad:
             self.consecutive_bad = 0
             return "ok"

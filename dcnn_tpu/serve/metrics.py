@@ -200,7 +200,8 @@ class ServeMetrics:
         for name, v in derived.items():
             if v is None:
                 continue  # absent series, not a lying 0.0
-            lines.extend(render_scalar(name, "gauge", v))
+            lines.extend(render_scalar(
+                name, "gauge", v))  # dcnn: metric=serve_latency_window_*_ms,serve_batch_occupancy,serve_shed_fraction,serve_throughput_rps
         return "\n".join(lines) + "\n"
 
     def __repr__(self) -> str:
@@ -424,7 +425,8 @@ class RouterMetrics:
                             s[p]["p99_ms"])):
                 if v is None:
                     continue  # absent series, not a lying 0.0
-                lines.extend(render_scalar(key, "gauge", v))
+                lines.extend(render_scalar(
+                    key, "gauge", v))  # dcnn: metric=serve_router_latency_window_*
         return "\n".join(lines) + "\n"
 
     def __repr__(self) -> str:

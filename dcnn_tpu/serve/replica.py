@@ -400,7 +400,19 @@ class ReplicaServer:
         try:
             while True:
                 cmd, meta, payload = ch.recv()
-                self._handle(ch, cmd, meta, payload)
+                try:
+                    self._handle(ch, cmd, meta, payload)
+                except (ChannelClosed, ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    # a handler exception is ONE request's failure, not
+                    # the channel's: reply typed (the error frame exists
+                    # for exactly this) instead of unwinding the reader
+                    # and failing every in-flight request on this
+                    # connection (found annotating the replica.c2s map —
+                    # only the infer arm replied error before)
+                    self._send(ch, "error",
+                               self._err_meta(meta.get("id"), e))
         except (ChannelClosed, ConnectionError, OSError):
             pass  # router went away; its pending futures are its problem
 
@@ -418,8 +430,9 @@ class ReplicaServer:
                 "queue_capacity": r.queue_capacity,
                 "input_shape": list(r.input_shape)}
 
+    # dcnn: protocol=replica.c2s role=handler
     def _handle(self, ch: Channel, cmd: str, meta: Dict[str, Any],
-                payload) -> None:
+                payload) -> None:  # dcnn: protocol=replica.s2c role=sender
         if cmd == "infer":
             rid = meta["id"]
             try:
@@ -466,7 +479,8 @@ class ReplicaServer:
         return {"id": rid, "etype": type(exc).__name__, "emsg": str(exc),
                 "dead": isinstance(exc, DEATH_ERRORS)}
 
-    def _reply(self, ch: Channel, rid, fut: Future) -> None:
+    def _reply(self, ch: Channel, rid,
+               fut: Future) -> None:  # dcnn: protocol=replica.s2c role=sender
         if fut.cancelled():
             self._send(ch, "error", {"id": rid, "etype": "CancelledError",
                                      "emsg": "cancelled", "dead": False})
@@ -478,7 +492,8 @@ class ReplicaServer:
         else:
             self._send(ch, "error", self._err_meta(rid, exc))
 
-    def _do_swap(self, ch: Channel, rid, version) -> None:
+    def _do_swap(self, ch: Channel, rid,
+                 version) -> None:  # dcnn: protocol=replica.s2c role=sender
         try:
             self.replica.swap(version)
         except Exception as e:
@@ -582,6 +597,7 @@ class TcpReplica:
         except (ChannelClosed, ConnectionError, OSError) as e:
             self._mark_dead(f"connection closed: {e}")
 
+    # dcnn: protocol=replica.s2c role=handler
     def _on_frame(self, cmd: str, meta: Dict[str, Any], payload) -> None:
         with self._lock:
             self._last_heard = self._clock()
@@ -645,7 +661,10 @@ class TcpReplica:
         with self._lock:
             fut, _ = self._pending.pop(rid, (None, 0))
             sfut = self._swaps.pop(rid, None)
-        for f in (fut, sfut):
+            # stats futures too: an error reply carrying a stats id
+            # otherwise strands stats() for its full timeout
+            tfut = self._stats.pop(rid, None)
+        for f in (fut, sfut, tfut):
             if f is not None:
                 try:
                     f.set_exception(exc)
@@ -705,7 +724,7 @@ class TcpReplica:
         with self._lock:
             return sum(n for _, n in self._pending.values())
 
-    def submit(self, x) -> Future:
+    def submit(self, x) -> Future:  # dcnn: protocol=replica.c2s role=sender
         x = np.asarray(x, dtype=np.float32)
         with self._lock:
             if self._dead_reason is not None:
@@ -726,7 +745,7 @@ class TcpReplica:
             raise
         return fut
 
-    def ping(self) -> None:
+    def ping(self) -> None:  # dcnn: protocol=replica.c2s role=sender
         """Fire-and-forget liveness probe; the pong refreshes
         ``last_heard`` + the cached remote health/version. Send failures
         mark the replica dead (that IS the probe result).
@@ -777,7 +796,8 @@ class TcpReplica:
         with self._lock:
             return self._dead_reason is not None
 
-    def stats(self, timeout: Optional[float] = 10.0) -> Dict[str, Any]:
+    def stats(self, timeout: Optional[float] = 10.0
+              ) -> Dict[str, Any]:  # dcnn: protocol=replica.c2s role=sender
         with self._lock:
             if self._dead_reason is not None:
                 raise ReplicaDeadError(
@@ -789,7 +809,9 @@ class TcpReplica:
         self._send("stats", {"id": rid})
         return fut.result(timeout=timeout)
 
-    def swap(self, version, timeout: Optional[float] = 300.0) -> None:
+    def swap(self, version,
+             timeout: Optional[float] = 300.0
+             ) -> None:  # dcnn: protocol=replica.c2s role=sender
         """Remote drain → load → rejoin; blocks until the server answers
         ``swapped`` or ``error`` (re-raised typed). A wait past
         ``timeout`` surfaces as :class:`SwapError` too, with the pending

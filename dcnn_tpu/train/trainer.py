@@ -730,7 +730,8 @@ class Trainer:
                         self.model, ts.params, ts.state, x,
                         training=True, rng=epoch_rng)
                     grad = jax.grad(
-                        lambda out: self.loss_fn(out, jnp.asarray(y)))(logits)
+                        lambda out, _y=y: self.loss_fn(
+                            out, jnp.asarray(_y)))(logits)
                     self.profiler.profile_backward(
                         self.model, ts.params, ts.state, x, grad,
                         rng=epoch_rng)

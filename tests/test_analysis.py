@@ -442,6 +442,657 @@ def test_at01_np_save_and_helper_exemption(tmp_path):
     assert not [f for f in hits if f.check_id == "AT01"]
 
 
+# ---------------------------------------------------------------- DL01 --
+_DL01_POSITIVE = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._la = threading.Lock()
+            self.b = B()
+
+        def foo(self):
+            with self._la:
+                self.b.bar()
+
+        def quux(self):
+            with self._la:
+                pass
+
+    class B:
+        def __init__(self):
+            self._lb = threading.Lock()
+            self.a = A()
+
+        def bar(self):
+            with self._lb:
+                pass
+
+        def back(self):
+            with self._lb:
+                self.a.quux()
+    """
+
+_DL01_CLEAN = _DL01_POSITIVE.replace(
+    "        def back(self):\n"
+    "            with self._lb:\n"
+    "                self.a.quux()",
+    "        def back(self):\n"
+    "            self.a.quux()")
+
+
+def test_dl01_lock_order_cycle(tmp_path):
+    hit = _quad(tmp_path, "DL01", _DL01_POSITIVE, _DL01_CLEAN)
+    assert "A._la" in hit.detail and "B._lb" in hit.detail
+
+
+def test_dl01_edges_and_cycle_canonical(tmp_path):
+    """The acquisition graph records cross-class edges (attribute-typed
+    resolution) and reports each cycle exactly once."""
+    from dcnn_tpu.analysis.core import load_project
+    from dcnn_tpu.analysis.locks import LockAnalysis, _cycles
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent(_DL01_POSITIVE))
+    a = LockAnalysis(load_project([str(root)]))
+    edges = set(a.edges)
+    assert ("m.A._la", "m.B._lb") in edges
+    assert ("m.B._lb", "m.A._la") in edges
+    cycles = _cycles(a.edges)
+    assert len(cycles) == 1 and set(cycles[0]) == {"m.A._la", "m.B._lb"}
+
+
+def test_dl01_annotation_typed_attr_resolves(tmp_path):
+    # the deferred-construction idiom: typing comes from the AnnAssign
+    hits = live(run_snippet(tmp_path, """
+        import threading
+        from typing import Optional
+
+        class Chan:
+            def __init__(self):
+                self._cl = threading.Lock()
+
+            def send(self):
+                with self._cl:
+                    pass
+
+        class Owner:
+            def __init__(self):
+                self._ol = threading.Lock()
+                self.chan: Optional[Chan] = None
+
+            def push(self):
+                with self._ol:
+                    self.chan.send()
+
+        class Back:
+            def __init__(self):
+                self._cl2 = threading.Lock()
+
+        def hold(o: Owner, c: Chan):
+            with c._cl:
+                pass
+        """, checks=["DL01"]))
+    # no cycle — but the edge machinery resolved Owner._ol -> Chan._cl
+    from dcnn_tpu.analysis.core import load_project
+    from dcnn_tpu.analysis.locks import LockAnalysis
+    root = tmp_path / "p0" / "pkg"
+    a = LockAnalysis(load_project([str(root)]))
+    assert ("snippet.Owner._ol", "snippet.Chan._cl") in a.edges
+    assert not hits
+
+
+# ---------------------------------------------------------------- DL02 --
+def test_dl02_blocking_under_lock(tmp_path):
+    hit = _quad(tmp_path, "DL02", """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(0.5)
+        """, """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    pass
+                time.sleep(0.5)
+        """)
+    assert "sleep" in hit.detail
+
+
+def test_dl02_transitive_frame_send(tmp_path):
+    # the wedge class PRs 8-13 fixed by hand: a framed-channel send
+    # reached through a helper while the caller holds its lock
+    hits = live(run_snippet(tmp_path, """
+        import threading
+
+        class Mesh:
+            def __init__(self, chan):
+                self._lock = threading.Lock()
+                self.chan = chan
+
+            def _ship(self):
+                self.chan.send("BEAT", {})
+
+            def beat(self):
+                with self._lock:
+                    self._ship()
+        """, checks=["DL02"]))
+    assert any(f.check_id == "DL02" and f.symbol == "Mesh.beat"
+               for f in hits)
+
+
+def test_dl02_queue_get_and_future_result(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def a(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+
+            def b(self, fut):
+                with self._lock:
+                    return fut.result()
+
+            def ok(self, d):
+                with self._lock:
+                    return d.get("key")  # dict get: not blocking
+        """, checks=["DL02"]))
+    assert sum(1 for f in hits if f.check_id == "DL02") == 2
+
+
+def test_dl01_lexical_nesting_and_multi_item_with(tmp_path):
+    """Same-statement orderings must reach the graph: nested ``with``
+    blocks in one function, and multi-item ``with A, B:`` (which
+    acquires A then B — the textbook AB/BA deadlock shape)."""
+    from dcnn_tpu.analysis.core import load_project
+    from dcnn_tpu.analysis.locks import LockAnalysis
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def nested(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def multi(self):
+                with self._lb, self._la:
+                    pass
+        """))
+    a = LockAnalysis(load_project([str(root)]))
+    assert ("m.C._la", "m.C._lb") in a.edges   # lexical nesting
+    assert ("m.C._lb", "m.C._la") in a.edges   # multi-item ordering
+    hits = live(run_snippet(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def nested(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def multi(self):
+                with self._lb, self._la:
+                    pass
+        """, checks=["DL01"], phase="multiwith"))
+    assert any(f.check_id == "DL01" for f in hits)
+
+
+def test_dl01_mutual_recursion_does_not_poison_memo(tmp_path):
+    """A cycle-truncated _acquires result must not be cached: after
+    resolving a mutually-recursive pair from one entry point, an
+    unrelated caller's edge into the pair must still be recorded."""
+    from dcnn_tpu.analysis.core import load_project
+    from dcnn_tpu.analysis.locks import LockAnalysis
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+                self._ld = threading.Lock()
+
+            def a(self, n):
+                with self._la:
+                    pass
+                self.b(n)
+
+            def b(self, n):
+                with self._lb:
+                    pass
+                self.a(n)
+
+            def c(self):
+                self.a(1)
+
+            def d(self):
+                with self._ld:
+                    self.b(1)
+        """))
+    a = LockAnalysis(load_project([str(root)]))
+    assert ("m.C._ld", "m.C._la") in a.edges
+    assert ("m.C._ld", "m.C._lb") in a.edges
+
+
+# ---------------------------------------------------------------- PR01 --
+_PR01_POSITIVE = """
+    class Client:
+        def request(self, ch):  # dcnn: protocol=demo role=sender
+            ch.send("PING", {})
+            ch.send("QUERY", {})
+
+    class Server:
+        def pump(self, cmd, meta):  # dcnn: protocol=demo role=handler
+            if cmd == "PING":
+                return "pong"
+    """
+
+_PR01_CLEAN = _PR01_POSITIVE.replace(
+    '            if cmd == "PING":\n                return "pong"',
+    '            if cmd == "PING":\n                return "pong"\n'
+    '            if cmd == "QUERY":\n                return "result"')
+
+
+def test_pr01_frame_unhandled(tmp_path):
+    hit = _quad(tmp_path, "PR01", _PR01_POSITIVE, _PR01_CLEAN)
+    assert hit.detail == "demo:QUERY"
+
+
+def test_pr01_no_handler_and_wildcard(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        class OnlySender:
+            def go(self, ch):  # dcnn: protocol=orphan role=sender
+                ch.send("X", {})
+        """, checks=["PR01"], phase="nohandler"))
+    assert any(f.detail == "orphan:<no-handler>" for f in hits)
+    hits = live(run_snippet(tmp_path, """
+        class S:
+            def go(self, ch):  # dcnn: protocol=wild role=sender
+                ch.send("X", {})
+
+        class H:
+            def pump(self, cmd):  # dcnn: protocol=wild role=handler frames=*
+                pass
+        """, checks=["PR01"], phase="wildcard"))
+    assert not hits
+
+
+def test_pr01_declared_frames_and_line_rebind(tmp_path):
+    # frames= declares dynamically-consumed arms; a line-scoped
+    # annotation rebinds one send to another protocol
+    hits = live(run_snippet(tmp_path, """
+        class S:
+            def go(self, ch):  # dcnn: protocol=a role=sender
+                ch.send("X", {})
+                ch.send("Y", {})  # dcnn: protocol=b
+
+        class HA:
+            def pump(self, cmd):  # dcnn: protocol=a role=handler frames=X
+                pass
+
+        class HB:
+            def pump(self, cmd):  # dcnn: protocol=b role=handler
+                if cmd == "Y":
+                    return 1
+        """, checks=["PR01"], phase="declared"))
+    assert not hits
+
+
+def test_pr01_line_rebind_does_not_leak_to_adjacent_send(tmp_path):
+    """A trailing line annotation on one send must not rebind the send
+    starting on the very next line."""
+    hits = live(run_snippet(tmp_path, """
+        class S:
+            def go(self, ch):  # dcnn: protocol=main role=sender
+                ch.send("A", {})  # dcnn: protocol=side
+                ch.send("B", {})
+
+        class HM:
+            def pump(self, cmd):  # dcnn: protocol=main role=handler
+                if cmd == "B":
+                    return 1
+
+        class HS:
+            def pump(self, cmd):  # dcnn: protocol=side role=handler
+                if cmd == "A":
+                    return 1
+        """, checks=["PR01"], phase="adjacent"))
+    # B stays on 'main' (handled), A rebinds to 'side' (handled) — a
+    # leak would move B to 'side' where it has no arm
+    assert not hits
+
+
+# ---------------------------------------------------------------- PR02 --
+_PR02_POSITIVE = """
+    class Coord:
+        def kick(self, ch):  # dcnn: protocol=gens role=sender
+            ch.send("JOB", {"gen": 3, "mb": 1})
+
+    class Worker:
+        def __init__(self):
+            self.gen = 0
+
+        def pump(self, cmd, meta, payload):  # dcnn: protocol=gens role=handler
+            if cmd == "JOB":
+                return payload * 2
+    """
+
+_PR02_CLEAN = _PR02_POSITIVE.replace(
+    '            if cmd == "JOB":\n                return payload * 2',
+    '            if cmd == "JOB":\n'
+    '                if meta.get("gen") != self.gen:\n'
+    '                    return None\n'
+    '                return payload * 2')
+
+
+def test_pr02_unfenced_stamp(tmp_path):
+    hit = _quad(tmp_path, "PR02", _PR02_POSITIVE, _PR02_CLEAN)
+    assert hit.detail == "gens:JOB:gen"
+
+
+def test_pr02_global_fence_and_drop_arm(tmp_path):
+    # a loop-level fence (outside every arm) covers every frame; a
+    # drop-only arm needs no fence
+    hits = live(run_snippet(tmp_path, """
+        class Coord:
+            def kick(self, ch):  # dcnn: protocol=g2 role=sender
+                ch.send("JOB", {"gen": 3})
+                ch.send("TICK", {"gen": 3})
+
+        class W:
+            def __init__(self):
+                self.gen = 0
+
+            def pump(self, cmd, meta):  # dcnn: protocol=g2 role=handler
+                if meta.get("gen") != self.gen:
+                    return None
+                if cmd == "JOB":
+                    return 1
+                if cmd == "TICK":
+                    pass
+        """, checks=["PR02"], phase="globalfence"))
+    assert not hits
+
+
+def test_pr02_echo_does_not_leak_across_elif_arms(tmp_path):
+    # an echo in a LATER elif arm must not exempt an EARLIER arm's
+    # unfenced use of the same stamp key (the elif chain nests in the
+    # first If's orelse — a whole-node walk would swallow it)
+    hits = live(run_snippet(tmp_path, """
+        class Coord:
+            def kick(self, ch):  # dcnn: protocol=leak role=sender
+                ch.send("JOB", {"gen": 1})
+                ch.send("CHECK", {"gen": 1})
+
+        class W:
+            def pump(self, cmd, meta, payload, ch):  # dcnn: protocol=leak role=handler
+                if cmd == "JOB":
+                    return payload * 2
+                elif cmd == "CHECK":
+                    ch.send("ACK", {"gen": meta.get("gen")})
+        """, checks=["PR02"], phase="eleak"))
+    assert [f.detail for f in hits] == ["leak:JOB:gen"]
+
+
+def test_cli_only_filter_keeps_whole_project_accuracy(tmp_path):
+    """--only analyzes everything but reports just the named files — a
+    sender-only file must NOT produce a '<no-handler>' PR01 finding when
+    its handler lives in an unreported sibling (the check.sh
+    --changed-only contract)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "sender.py").write_text(textwrap.dedent("""
+        class S:
+            def go(self, ch):  # dcnn: protocol=x role=sender
+                ch.send("PING", {})
+    """))
+    (pkg / "handler.py").write_text(textwrap.dedent("""
+        class H:
+            def pump(self, cmd):  # dcnn: protocol=x role=handler
+                if cmd == "PING":
+                    return 1
+    """))
+    r = _cli(str(pkg), "--no-baseline", "--only", "pkg/sender.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # scoping to the handler file with the handler arm REMOVED must
+    # still flag — the filter narrows the report, not the analysis
+    (pkg / "handler.py").write_text(textwrap.dedent("""
+        class H:
+            def pump(self, cmd):  # dcnn: protocol=x role=handler
+                pass
+    """))
+    r = _cli(str(pkg), "--no-baseline", "--only", "pkg/handler.py")
+    assert r.returncode == 1 and "PR01" in r.stdout
+
+
+def test_pr02_echo_exempt(tmp_path):
+    # the responder half of a nonce round-trip echoes the stamp for the
+    # REQUESTER to fence — no comparison required on the responder
+    hits = live(run_snippet(tmp_path, """
+        class Coord:
+            def probe(self, ch):  # dcnn: protocol=g3 role=sender
+                ch.send("CHECK", {"nonce": 7})
+
+        class W:
+            def pump(self, cmd, meta, ch):  # dcnn: protocol=g3 role=handler
+                if cmd == "CHECK":
+                    ch.send("ACK", {"nonce": meta.get("nonce")})
+        """, checks=["PR02"], phase="echo"))
+    assert not hits
+
+
+def test_protocol_map_stamps_and_aliases(tmp_path):
+    from dcnn_tpu.analysis.core import load_project
+    from dcnn_tpu.analysis.protocol import ProtocolMap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent("""
+        class S:
+            def ship(self, ch):  # dcnn: protocol=pm role=sender
+                meta = {"gen": 1, "size": 4}
+                ch.send("CONFIG", meta, raw=b"x")
+
+            def round(self, ch, req):  # dcnn: protocol=pm role=sender frames=ASK
+                ch.send(req, {"nonce": 9})
+        """))
+    pm = ProtocolMap(load_project([str(root)]))
+    assert set(pm.emitted["pm"]) == {"CONFIG", "ASK"}
+    assert pm.stamps["pm"]["CONFIG"] == {"gen"}   # dict-literal alias
+    assert pm.stamps["pm"]["ASK"] == {"nonce"}    # declared-frame stamp
+
+
+# ---------------------------------------------------------------- TS06 --
+def test_ts06_jit_of_lambda(tmp_path):
+    hit = _quad(tmp_path, "TS06", """
+        import jax
+
+        def make():
+            return jax.jit(lambda x: x * 2)
+        """, """
+        import jax
+
+        def _double(x):
+            return x * 2
+
+        step = jax.jit(_double)
+        """)
+    assert hit.detail == "lambda"
+
+
+def test_ts06_jit_per_call_and_in_loop(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def f(x):
+            return x + 1
+
+        def run(xs):
+            out = []
+            for x in xs:
+                out.append(jax.jit(f)(x))
+            return out
+        """, checks=["TS06"], phase="percall"))
+    details = {f.detail for f in hits if f.check_id == "TS06"}
+    assert "jit-per-call" in details
+
+
+def test_ts06_static_churn_and_shape_varying(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def f(x, n):
+            return x * n
+
+        step = jax.jit(f, static_argnums=(1,))
+
+        def drive(x, batch):
+            a = step(x, len(batch))     # static churn: recompile per len
+            b = step(x[:len(batch)], 1)  # shape-varying traced arg
+            return a, b
+        """, checks=["TS06"], phase="churn"))
+    details = {f.detail for f in hits if f.check_id == "TS06"}
+    assert "step:static#1" in details
+    assert "step:shape#0" in details
+    # constants and bare names in static positions are fine
+    clean = live(run_snippet(tmp_path, """
+        import jax
+
+        def f(x, n):
+            return x * n
+
+        step = jax.jit(f, static_argnums=(1,))
+
+        def drive(x, flag):
+            return step(x, 4), step(x, flag)
+        """, checks=["TS06"], phase="churnclean"))
+    assert not [f for f in clean if f.check_id == "TS06"]
+
+
+def test_ts06_static_argnames_kwarg(tmp_path):
+    hits = live(run_snippet(tmp_path, """
+        import jax
+
+        def f(x, mode=0):
+            return x * mode
+
+        step = jax.jit(f, static_argnames=("mode",))
+
+        def drive(x, items):
+            return step(x, mode=len(items))
+        """, checks=["TS06"], phase="kwname"))
+    assert any(f.detail == "step:static:mode" for f in hits)
+
+
+# ------------------------------------------------- coverage lints (CLI) --
+def test_fault_coverage_lint(tmp_path):
+    from dcnn_tpu.analysis.coverage import check_fault_coverage
+    pkg = tmp_path / "pkg"
+    tests = tmp_path / "tests"
+    pkg.mkdir()
+    tests.mkdir()
+    (pkg / "prod.py").write_text(textwrap.dedent("""
+        from resilience import faults as _faults
+
+        def save():
+            _faults.trip("ckpt.demo_write")
+
+        def ship():
+            _faults.trip("net.demo_send")
+        """))
+    (tests / "test_x.py").write_text(
+        'def test_armed(plan):\n    plan.arm("ckpt.demo_write")\n')
+    findings = check_fault_coverage(str(pkg), str(tests))
+    assert [f.detail for f in findings] == ["net.demo_send"]
+    # arming the second point clears the lint
+    (tests / "test_y.py").write_text('POINT = "net.demo_send"\n')
+    assert not check_fault_coverage(str(pkg), str(tests))
+
+
+def test_metric_drift_lint(tmp_path):
+    from dcnn_tpu.analysis.coverage import check_metric_drift
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "prod.py").write_text(textwrap.dedent("""
+        def emit(reg, p):
+            reg.counter("demo_requests_total").inc()
+            reg.gauge(f"demo_depth_{p}").set(1)
+        """))
+    doc = tmp_path / "observability.md"
+    doc.write_text("The series `demo_requests_total` and "
+                   "`demo_depth_<class>` plus `demo_dead_total`.\n")
+    findings = check_metric_drift(str(pkg), str(doc))
+    assert [f.detail for f in findings] == ["demo_dead_total"]
+    doc.write_text("`demo_requests_total` `demo_depth_<class>`\n")
+    assert not check_metric_drift(str(pkg), str(doc))
+    # an unresolvable dynamic name is itself a finding, and the
+    # # dcnn: metric= declaration resolves it
+    (pkg / "dyn.py").write_text(textwrap.dedent("""
+        def emit(reg, name):
+            reg.counter(name).inc()
+        """))
+    findings = check_metric_drift(str(pkg), str(doc))
+    assert any(f.detail == "<unresolvable>" for f in findings)
+    (pkg / "dyn.py").write_text(textwrap.dedent("""
+        def emit(reg, name):
+            reg.counter(name).inc()  # dcnn: metric=demo_requests_total
+        """))
+    assert not check_metric_drift(str(pkg), str(doc))
+
+
+def test_cli_lint_flags(tmp_path):
+    pkg = tmp_path / "pkg"
+    tests = tmp_path / "tests"
+    pkg.mkdir()
+    tests.mkdir()
+    (pkg / "prod.py").write_text(
+        'from x import trip\n\ndef f():\n    trip("demo.point")\n')
+    doc = tmp_path / "obs.md"
+    doc.write_text("nothing\n")
+    r = _cli(str(pkg), "--fault-coverage", "--tests", str(tests))
+    assert r.returncode == 1 and "demo.point" in r.stdout
+    (tests / "test_a.py").write_text('P = "demo.point"\n')
+    r = _cli(str(pkg), "--fault-coverage", "--tests", str(tests))
+    assert r.returncode == 0
+    (pkg / "m.py").write_text(
+        'def f(reg):\n    reg.counter("demo_x_total").inc()\n')
+    r = _cli(str(pkg), "--metric-drift", "--doc", str(doc))
+    assert r.returncode == 1 and "demo_x_total" in r.stdout
+    doc.write_text("`demo_x_total`\n")
+    r = _cli(str(pkg), "--metric-drift", "--doc", str(doc))
+    assert r.returncode == 0
+
+
 # ------------------------------------------------------------ plumbing --
 def test_parse_error_is_a_finding(tmp_path):
     hits = live(run_snippet(tmp_path, "def broken(:\n"))
@@ -455,7 +1106,8 @@ def test_unknown_check_id_raises(tmp_path):
 
 def test_every_check_id_registered():
     assert set(all_checks()) == {"TS01", "TS02", "TS03", "TS04", "TS05",
-                                 "CC01", "CC02", "CC03", "AT01"}
+                                 "TS06", "CC01", "CC02", "CC03", "AT01",
+                                 "DL01", "DL02", "PR01", "PR02"}
 
 
 # ------------------------------------------------------------------ CLI --

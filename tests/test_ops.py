@@ -202,7 +202,7 @@ def test_loss_grads_match_autodiff(rng):
         (huber_loss, huber_grad, logits),
     ]
     for loss_fn, grad_fn, pred in pairs:
-        g_auto = jax.grad(lambda p: loss_fn(p, onehot))(pred)
+        g_auto = jax.grad(lambda p, _fn=loss_fn: _fn(p, onehot))(pred)
         np.testing.assert_allclose(np.asarray(grad_fn(pred, onehot)), np.asarray(g_auto),
                                    rtol=1e-4, atol=1e-6)
 

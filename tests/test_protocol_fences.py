@@ -1,0 +1,235 @@
+"""Regression tests for the protocol-fence defects PR 14's static
+analysis surfaced (PR02 unfenced-stamp, PR01 annotation workflow):
+
+- a straggler ABORT from an older recovery must not regress a stage
+  worker's generation (un-fencing the dead batch's in-flight jobs) or
+  roll back stage state a newer generation already rebuilt;
+- a LOAD_REPORT straggler from a timed-out earlier round must not
+  satisfy a later ``collect_load_reports`` join (the nonce round-trip
+  the profiling/gather rounds already had).
+
+Both run socket-free: the worker is driven through ``_dispatch`` with a
+recording fake channel, the coordinator is assembled around a real
+``Inbox`` with instant-echo fake stage channels.
+"""
+
+import collections
+
+from dcnn_tpu.parallel.comm import Inbox
+from dcnn_tpu.parallel.distributed_pipeline import (
+    DistributedPipelineCoordinator)
+from dcnn_tpu.parallel.worker import StageWorker
+
+
+class RecordingChannel:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, cmd, meta=None, array=None, raw=None, **kw):
+        self.sent.append((cmd, dict(meta or {})))
+
+
+class FakeStage:
+    """Just enough PipelineStage surface for ABORT / LOAD_REPORT arms."""
+
+    def __init__(self):
+        self.aborts = []
+        self.load = self
+
+    def abort(self, snap=None):
+        self.aborts.append(snap)
+
+    def report(self):
+        return {"fwd_ms": 1.0}
+
+
+def make_worker():
+    w = StageWorker(port=0)
+    w.coord = RecordingChannel()
+    w.stage = FakeStage()
+    return w
+
+
+# ----------------------------------------------------------- ABORT gen --
+
+def test_stale_abort_does_not_regress_generation():
+    w = make_worker()
+    w._dispatch("ABORT", {"gen": 3}, None, None)
+    assert w.gen == 3
+    assert w.coord.sent[-1] == ("ABORTED", {"stage_id": -1, "gen": 3})
+    n_acks = len(w.coord.sent)
+    n_aborts = len(w.stage.aborts)
+
+    # straggler from an older recovery: dropped — gen unchanged, no
+    # state rollback, no ack (the old drain has long moved on)
+    w._dispatch("ABORT", {"gen": 2}, None, None)
+    assert w.gen == 3
+    assert len(w.coord.sent) == n_acks
+    assert len(w.stage.aborts) == n_aborts
+
+    # duplicate of the current generation: equally stale
+    w._dispatch("ABORT", {"gen": 3}, None, None)
+    assert w.gen == 3
+    assert len(w.coord.sent) == n_acks
+
+    # a genuinely newer abort still lands
+    w._dispatch("ABORT", {"gen": 4}, None, None)
+    assert w.gen == 4
+    assert w.coord.sent[-1][0] == "ABORTED"
+    assert len(w.stage.aborts) == n_aborts + 1
+
+
+def test_genless_abort_still_advances():
+    # legacy/defensive path: an ABORT with no gen key bumps by one
+    w = make_worker()
+    w.gen = 5
+    w._dispatch("ABORT", {}, None, None)
+    assert w.gen == 6
+
+
+def test_stale_job_stays_fenced_after_stale_abort():
+    """The actual hazard: before the fix, a stale ABORT regressed
+    ``gen``, so a FORWARD_JOB straggler of the dead batch passed the
+    ``gen < current`` fence and poisoned residuals."""
+    w = make_worker()
+    w._dispatch("ABORT", {"gen": 3}, None, None)
+    w._dispatch("ABORT", {"gen": 1}, None, None)   # straggler, dropped
+    assert w.gen == 3
+    # a gen-1 job from the dead batch must still be fenced out (it would
+    # hit FakeStage and blow up on .batch_open if dispatched)
+    w._dispatch("FORWARD_JOB", {"gen": 1, "mb_id": 0}, None, None)
+    assert all(c != "FORWARD_RESULT" for c, _ in w.coord.sent)
+
+
+# -------------------------------------------------- LOAD_REPORT nonce --
+
+def test_worker_echoes_load_report_nonce():
+    w = make_worker()
+    w._dispatch("LOAD_REPORT_REQUEST", {"nonce": 42}, None, None)
+    cmd, meta = w.coord.sent[-1]
+    assert cmd == "LOAD_REPORT"
+    assert meta["nonce"] == 42
+    assert meta["report"] == {"fwd_ms": 1.0}
+
+
+class EchoStageChannel:
+    """A stage channel whose worker replies instantly into the inbox."""
+
+    def __init__(self, inbox, stage_id, report):
+        self.inbox = inbox
+        self.stage_id = stage_id
+        self.report = report
+
+    def send(self, cmd, meta=None, array=None, raw=None, **kw):
+        assert cmd == "LOAD_REPORT_REQUEST"
+        self.inbox.post("LOAD_REPORT",
+                        {"stage_id": self.stage_id,
+                         "nonce": (meta or {}).get("nonce"),
+                         "report": self.report})
+
+
+def make_coordinator(n_stages, reports):
+    c = object.__new__(DistributedPipelineCoordinator)
+    c.inbox = Inbox()
+    c._deferred = collections.deque()
+    c.chans = [EchoStageChannel(c.inbox, i, reports[i])
+               for i in range(n_stages)]
+    c.num_stages = n_stages
+    c.timeout = 5.0
+    c._gen = 0
+    return c
+
+
+def test_stale_load_report_is_fenced():
+    fresh = [{"fwd_ms": 10.0}, {"fwd_ms": 20.0}]
+    c = make_coordinator(2, fresh)
+    # a straggler from a timed-out earlier round sits in the inbox ahead
+    # of everything the new round will produce
+    c.inbox.post("LOAD_REPORT", {"stage_id": 0, "nonce": 12345,
+                                 "report": {"fwd_ms": 999.0}})
+    got = c.collect_load_reports()
+    # the stale table must not displace stage 0's fresh reply
+    assert got == fresh
+    # and the armed nonce is cleared after the round
+    assert c._load_nonce is None
+
+
+def test_nonceless_load_report_is_fenced_too():
+    # a reply predating the nonce protocol (meta lacks the key) must
+    # also be dropped, not treated as matching None mid-round
+    fresh = [{"fwd_ms": 10.0}]
+    c = make_coordinator(1, fresh)
+    c.inbox.post("LOAD_REPORT", {"stage_id": 0,
+                                 "report": {"fwd_ms": 999.0}})
+    assert c.collect_load_reports() == fresh
+
+
+# ------------------------------------- replica error-frame conformance --
+
+def test_replica_server_handler_exception_replies_error_not_teardown():
+    """A handler exception is one request's failure: the server must
+    reply a typed 'error' frame and keep serving the channel, not unwind
+    the reader (which failed every in-flight request of that router
+    connection)."""
+    from dcnn_tpu.parallel.comm import ChannelClosed
+    from dcnn_tpu.serve.replica import ReplicaServer
+
+    class BrokenReplica:
+        name = "broken"
+
+        def stats(self):
+            raise RuntimeError("stats backend exploded")
+
+    class ScriptedChannel:
+        def __init__(self, frames):
+            self.frames = list(frames)
+            self.sent = []
+
+        def recv(self):
+            if not self.frames:
+                raise ChannelClosed("done")
+            return self.frames.pop(0)
+
+        def send(self, cmd, meta=None, array=None, **kw):
+            self.sent.append((cmd, dict(meta or {})))
+
+        def close(self):
+            pass
+
+    srv = ReplicaServer(BrokenReplica())
+    try:
+        ch = ScriptedChannel([
+            ("stats", {"id": 7}, None),
+            ("stats", {"id": 8}, None),   # channel must still be alive
+        ])
+        srv._serve(ch)
+        errors = [(c, m) for c, m in ch.sent if c == "error"]
+        assert [m["id"] for _c, m in errors] == [7, 8]
+        assert all(m["etype"] == "RuntimeError" for _c, m in errors)
+    finally:
+        srv.close()
+
+
+def test_tcp_replica_error_frame_resolves_stats_future():
+    """An error reply carrying a stats id must fail the stats future
+    typed — before the fix it was left to strand for its full timeout."""
+    import threading
+
+    from dcnn_tpu.serve.replica import ReplicaError, TcpReplica
+    from concurrent.futures import Future
+
+    r = object.__new__(TcpReplica)
+    r._lock = threading.Lock()
+    r._pending = {}
+    r._swaps = {}
+    r._stats = {}
+    fut = Future()
+    r._stats[7] = fut
+    r._on_error({"id": 7, "etype": "RuntimeError", "emsg": "boom",
+                 "dead": False})
+    assert not r._stats
+    try:
+        fut.result(timeout=0)
+        raise AssertionError("stats future should have failed typed")
+    except ReplicaError as e:
+        assert "boom" in str(e)

@@ -1,58 +1,109 @@
 #!/usr/bin/env bash
-# The one pre-merge gate: lint -> static analysis -> bench-gate self-test.
+# The one pre-merge gate: lint -> static analysis -> coverage lints ->
+# bench-gate self-test.
 #
-#   tools/check.sh            # run everything available, fail on any gate
+#   tools/check.sh                 # full run, fail on any gate
+#   tools/check.sh --changed-only  # analysis scoped to git-changed files
+#
+# --changed-only keeps the loop fast as the package grows: stage 2
+# still analyzes the whole package (the call graph, DL01's lock graph
+# and the PR01/PR02 protocol map are whole-project facts — a file-scoped
+# parse would fabricate '<no-handler>' findings for senders whose
+# handler lives elsewhere) but REPORTS only findings in the dcnn_tpu/*.py
+# files changed vs HEAD (staged, unstaged, and the last commit) via
+# --only, and ruff runs on just that set. Stage 3's cross-directory
+# lints are skipped. The full run remains the tier-1 contract
+# (tests/test_analysis.py::test_live_package_zero_unsuppressed).
 #
 # Stages:
-#   1. ruff (error-tier E/F rules, [tool.ruff] in pyproject.toml). Skipped
-#      with a notice when ruff is not installed — the container image does
-#      not ship it; the AST-level F-class issues are then still partially
-#      covered by stage 2's parse pass.
-#   2. python -m dcnn_tpu.analysis dcnn_tpu/ — the trace-safety /
-#      concurrency / atomicity suite against the committed baseline
-#      (docs/static_analysis.md). Zero unsuppressed findings required;
-#      this covers dcnn_tpu/aot/ (CC03 resource-lifecycle applies to its
-#      cross-process file locks), the autoscaler pair
-#      serve/autoscale.py + parallel/autoscale.py (CC01 guarded_by
-#      discipline on shared scaler/broker/lease state, CC02 on the
-#      control-loop poll thread and leased-segment runners), and the
-#      distributed-tracing layer obs/flight.py + obs/trace.py (AT01
-#      atomic-commit on bundle staging and the merged-trace write, CC01
-#      on the recorder's cooldown/seq state and the healthz edge lock)
-#      — all with zero baseline entries. The tracer's context plumbing
-#      keeps the disabled-path <100 ns no-op bound, asserted in
-#      tests/test_obs.py (propagation must cost nothing when off).
-#      The self-healing pipeline pair parallel/distributed_pipeline.py +
-#      parallel/worker.py is covered the same way: CC01 guarded_by
-#      discipline on the coordinator's liveness tables and the worker's
-#      beat-visible state, CC02 on both beat threads (daemon +
-#      stop-event + joined in shutdown()/serve()'s finally) — zero new
-#      baseline entries.
-#   3. benchmarks/compare.py --self-test — the bench regression gate's own
-#      fixture run (planted 25% drop must flag; clean history must pass).
+#   1. ruff (error tier + bugbear subset B006/B008/B023/B025,
+#      [tool.ruff.lint] in pyproject.toml). Skipped with a notice when
+#      ruff is not installed — the container image does not ship it; the
+#      AST-level F-class issues are then still partially covered by
+#      stage 2's parse pass.
+#   2. python -m dcnn_tpu.analysis — trace-safety (TS01-TS06 incl. the
+#      retrace/recompile check), concurrency (CC01-CC03), deadlock
+#      (DL01 lock-order cycles, DL02 blocking-under-lock), frame-protocol
+#      conformance over the four framed-TCP surfaces (PR01 handler
+#      exhaustiveness, PR02 generation/nonce fencing), and atomicity
+#      (AT01) against the committed baseline (docs/static_analysis.md).
+#      Zero unsuppressed findings required.
+#   3. coverage lints (full runs only — they span tests/ and docs/):
+#      --fault-coverage (every FaultPlan trip point armed by a test) and
+#      --metric-drift (obs.registry emissions <-> docs/observability.md,
+#      both directions).
+#   4. benchmarks/compare.py --self-test — the bench regression gate's
+#      own fixture run (planted 25% drop must flag; clean history must
+#      pass).
 #
 # Tier-1 pytest is intentionally NOT chained here (it has its own runner
 # and budget); this script is the fast pre-merge loop.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+changed_only=0
+for arg in "$@"; do
+  case "$arg" in
+    --changed-only) changed_only=1 ;;
+    *) echo "unknown argument: $arg" >&2; exit 2 ;;
+  esac
+done
+
 fail=0
 
-echo "== [1/3] ruff (E/F error tier) =="
+# the report scope: everything, or just the changed dcnn_tpu python files
+analysis_args=(dcnn_tpu/)
+run_analysis=1
+ruff_paths=(.)
+if [[ "$changed_only" == 1 ]]; then
+  mapfile -t changed < <(
+    { git diff --name-only HEAD 2>/dev/null;
+      git diff --name-only --cached 2>/dev/null;
+      git diff --name-only HEAD~1..HEAD 2>/dev/null; } \
+    | sort -u | grep -E '^dcnn_tpu/.*\.py$' || true)
+  # drop deleted files — the analyzers read from disk
+  existing=()
+  for f in "${changed[@]:-}"; do
+    [[ -n "$f" && -f "$f" ]] && existing+=("$f")
+  done
+  if [[ ${#existing[@]} -eq 0 ]]; then
+    echo "== changed-only: no changed dcnn_tpu/*.py files — analysis skipped =="
+    run_analysis=0
+    ruff_paths=()
+  else
+    echo "== changed-only: reporting ${#existing[@]} file(s) =="
+    only=$(IFS=,; echo "${existing[*]}")
+    analysis_args=(dcnn_tpu/ --only "$only")
+    ruff_paths=("${existing[@]}")
+  fi
+fi
+
+echo "== [1/4] ruff (E/F error tier + bugbear subset) =="
 if command -v ruff >/dev/null 2>&1; then
-  if ! ruff check .; then
+  if [[ ${#ruff_paths[@]} -gt 0 ]] && ! ruff check "${ruff_paths[@]}"; then
     fail=1
   fi
 else
   echo "ruff not installed — skipped (pip install ruff to enable)"
 fi
 
-echo "== [2/3] dcnn_tpu.analysis =="
-if ! python -m dcnn_tpu.analysis dcnn_tpu/; then
-  fail=1
+echo "== [2/4] dcnn_tpu.analysis =="
+if [[ "$run_analysis" == 1 ]]; then
+  if ! python -m dcnn_tpu.analysis "${analysis_args[@]}"; then
+    fail=1
+  fi
 fi
 
-echo "== [3/3] bench regression gate self-test =="
+if [[ "$changed_only" == 1 ]]; then
+  echo "== [3/4] coverage lints — skipped under --changed-only =="
+else
+  echo "== [3/4] fault-coverage + metric-drift lints =="
+  if ! python -m dcnn_tpu.analysis dcnn_tpu --fault-coverage --metric-drift; then
+    fail=1
+  fi
+fi
+
+echo "== [4/4] bench regression gate self-test =="
 if ! python benchmarks/compare.py --self-test; then
   fail=1
 fi
