@@ -165,6 +165,8 @@ def _measure(step, ts, x, y, key, steps, reps):
     from dcnn_tpu.obs import get_tracer
 
     tracer = get_tracer()  # no-op spans unless BENCH_OBS=1 enabled it
+    from dcnn_tpu.obs import get_registry
+
     rep_times = []
     for r in range(reps):
         t0 = time.perf_counter()
@@ -175,6 +177,12 @@ def _measure(step, ts, x, y, key, steps, reps):
                 ts, loss, _ = step(ts, x, y, jax.random.fold_in(key, i), 1e-3)
         hard_fence(loss)
         rep_times.append(time.perf_counter() - t0)
+        # tsdb history feed: created at first SET (not before the rep) so
+        # the capture-long sampler never records a pre-measurement zero
+        get_registry().gauge(
+            "bench_step_seconds_last",
+            "per-step wall of the newest bench rep (tsdb history feed)"
+        ).set(rep_times[-1] / steps)
     return min(rep_times), ts, rep_times
 
 
@@ -1376,6 +1384,18 @@ def main() -> None:
                   capacity=int(os.environ.get("BENCH_OBS_CAPACITY",
                                               "262144")))
 
+    # monitoring-plane history (dcnn_tpu/obs/tsdb.py): a sampler thread
+    # snapshots the registry for the WHOLE capture, so r06+ captures carry
+    # time-resolved step-time / h2d series (telemetry_essentials.history)
+    # next to the point-in-time numbers. BENCH_TSDB=0 opts out.
+    tsdb_sampler = None
+    if os.environ.get("BENCH_TSDB", "1") == "1":
+        from dcnn_tpu.obs.tsdb import TimeSeriesStore, TsdbSampler
+        tsdb_sampler = TsdbSampler(
+            TimeSeriesStore(retention=4096),
+            interval_s=float(os.environ.get("BENCH_TSDB_INTERVAL",
+                                            "0.25"))).start()
+
     root = os.path.dirname(os.path.abspath(__file__))
     # batch 2048 default, re-measured in r5 (26.2-26.5k img/s / 43.4-43.9%
     # MFU over six full runs; ≈24.2k median at the old 1024 default): the
@@ -1564,6 +1584,32 @@ def main() -> None:
         "train_step_bytes_per_flop": snap.get("train_step_bytes_per_flop"),
         "serve_flops_per_sample": snap.get("serve_flops_per_sample"),
     }
+
+    # time-resolved history block: stop the capture-long sampler, take a
+    # final pass (the last values always land), persist the JSONL next to
+    # the capture, and embed the compact min/mean/max stats the regress
+    # gate can anchor on
+    if tsdb_sampler is not None:
+        from dcnn_tpu.obs.tsdb import series_stats
+        tsdb_sampler.stop()
+        try:
+            tsdb_sampler.sample_once()
+        except Exception:
+            pass  # a broken provider must not cost the capture
+        store = tsdb_sampler.store
+        history_path = os.environ.get("BENCH_TSDB_PATH",
+                                      "/tmp/dcnn_bench_history.jsonl")
+        try:
+            store.persist(history_path)
+        except OSError:
+            history_path = None
+        out["telemetry_essentials"]["history"] = {
+            "path": history_path,
+            "series": len(store.series_names()),
+            "samples": store.samples,
+            "step_s": series_stats(store.range("bench_step_seconds_last")),
+            "h2d_gbps": series_stats(store.range("h2d_gbps")),
+        }
 
     if obs_on:
         from dcnn_tpu.obs import get_tracer

@@ -63,6 +63,30 @@ PR 12 made the tracer DISTRIBUTED and failures self-documenting:
   the process-global instance, off until ``DCNN_FLIGHT_DIR`` /
   :func:`configure_flight`.
 
+PR 15 grew the MONITORING PLANE on top (docs/observability.md
+"Monitoring plane"): retained history, rule evaluation, and fleet-wide
+aggregation —
+
+- :mod:`~dcnn_tpu.obs.tsdb` — :class:`TimeSeriesStore`: fixed-memory
+  per-series ring buffers + a downsampled coarse tier, a PromQL-style
+  over-time query API (``rate``/``delta``/``*_over_time``/
+  histogram-quantile), atomic ``history.jsonl`` persistence, and
+  :class:`TsdbSampler` (a cadence thread over the registry; sleep-free
+  by hand in tests). ``python -m dcnn_tpu.obs.tsdb`` is the postmortem
+  CLI (``report``/``export``/ASCII ``plot``).
+- :mod:`~dcnn_tpu.obs.rules` — :class:`RuleEngine`: declarative
+  recording rules and threshold/rate/absence alert rules with ``for_s``
+  hold windows (inactive → pending → firing → resolved); firing edges
+  bump ``alerts_fired_total``, export ``alert_state{rule=...}``, dump
+  ``alert_firing`` flight bundles with the offending series' window,
+  and degrade ``/healthz`` via :func:`rules_check`.
+- :mod:`~dcnn_tpu.obs.fleet` — :class:`FleetAggregator`: scrapes N
+  telemetry surfaces (HTTP via :class:`HttpScraper` or in-process),
+  merges them into labeled fleet series (per-replica + sum/max) in its
+  own tsdb, and serves ``/fleet`` + ``/alerts`` + a fleet ``/healthz``
+  roll-up; the serving ``Autoscaler`` reads its replica signals through
+  one of these.
+
 This package is stdlib-only at import time (no jax import) — safe to
 import from any layer, including before backend selection.
 """
@@ -74,10 +98,33 @@ from .server import (TelemetryServer, checkpoint_check, elastic_check,
                      pipeline_check, watchdog_check)
 from .tracer import Tracer, configure, get_tracer
 
+# monitoring-plane names resolve lazily (PEP 562): tsdb/rules/fleet stay
+# runnable as `python -m dcnn_tpu.obs.tsdb` without runpy's
+# already-imported warning, and the base import stays lean
+_LAZY = {
+    "TimeSeriesStore": "tsdb", "TsdbSampler": "tsdb",
+    "RuleEngine": "rules", "AlertRule": "rules",
+    "RecordingRule": "rules", "rules_check": "rules",
+    "FleetAggregator": "fleet", "HttpScraper": "fleet",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Tracer", "configure", "get_tracer",
     "TelemetryServer", "watchdog_check", "checkpoint_check",
     "elastic_check", "pipeline_check",
     "FlightRecorder", "get_flight_recorder", "configure_flight",
+    "TimeSeriesStore", "TsdbSampler",
+    "RuleEngine", "AlertRule", "RecordingRule", "rules_check",
+    "FleetAggregator", "HttpScraper",
 ]

@@ -92,7 +92,7 @@ class FlightRecorder:
     def __init__(self, directory: Optional[str] = None, *,
                  keep: int = 8, span_limit: int = 2048,
                  min_interval_s: float = 30.0,
-                 tracer=None, registry=None,
+                 tracer=None, registry=None, tsdb=None,
                  clock: Callable[[], float] = time.monotonic,
                  wall_clock: Callable[[], float] = time.time):
         if keep < 1:
@@ -106,6 +106,7 @@ class FlightRecorder:
         self.min_interval_s = min_interval_s
         self._tracer = tracer
         self._registry = registry
+        self._tsdb = tsdb
         self._clock = clock
         self._wall = wall_clock
         self._lock = threading.Lock()
@@ -119,6 +120,15 @@ class FlightRecorder:
     @property
     def enabled(self) -> bool:
         return bool(self.directory)
+
+    def attach_tsdb(self, store) -> "FlightRecorder":
+        """Wire a :class:`~dcnn_tpu.obs.tsdb.TimeSeriesStore`: every
+        bundle gains ``history.jsonl`` — the store's retained window, so
+        a postmortem shows the minutes BEFORE the trigger, not just the
+        counters at it. ``None`` detaches (owners detach at shutdown so
+        a dead run's store is not dumped into a later bundle)."""
+        self._tsdb = store
+        return self
 
     def _default_tracer(self):
         if self._tracer is not None:
@@ -208,10 +218,23 @@ class FlightRecorder:
         }
         name = f"{_BUNDLE_PREFIX}{int(t_wall * 1000):015d}-{seq:04d}-" \
                f"{_safe_slug(trigger)}"
+        tsdb = self._tsdb
+        if tsdb is not None:
+            try:
+                history = tsdb.to_jsonl_bytes()
+            except Exception:
+                history = None  # a broken store must not cost the bundle
+            manifest["history_series"] = (len(tsdb.series_names())
+                                          if history is not None else None)
+        else:
+            history = None
         tmp = stage_dir(self.directory)
         try:
             self._stage_json(tmp, "MANIFEST.json", manifest)
             self._stage_spans(tmp, trc, spans)
+            if history is not None:
+                write_file_atomic(os.path.join(tmp, "history.jsonl"),
+                                  history)
             self._stage_json(tmp, "metrics.json", reg.snapshot())
             if health is not None:
                 self._stage_json(tmp, "healthz.json", health)
@@ -312,16 +335,19 @@ def resolve_flight_recorder(flight: Optional[FlightRecorder] = None
 def configure_flight(directory: Optional[str] = None, *,
                      keep: Optional[int] = None,
                      span_limit: Optional[int] = None,
-                     min_interval_s: Optional[float] = None
-                     ) -> FlightRecorder:
+                     min_interval_s: Optional[float] = None,
+                     tsdb=None) -> FlightRecorder:
     """Reconfigure the process-global recorder IN PLACE (identity
     preserved — trigger sites that hoisted it stay wired). Passing a
-    ``directory`` enables it; ``None`` leaves the current one."""
+    ``directory`` enables it; ``None`` leaves the current one. ``tsdb``
+    attaches a history store (see :meth:`FlightRecorder.attach_tsdb`)."""
     r = _GLOBAL_FLIGHT
     if directory is not None:
         r.directory = directory
         with r._lock:
             r._swept = False  # new dir: sweep its stale tmp- on first use
+    if tsdb is not None:
+        r.attach_tsdb(tsdb)
     if keep is not None:
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")

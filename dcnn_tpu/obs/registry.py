@@ -221,6 +221,13 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, help, start=start,
                                    factor=factor, buckets=buckets)
 
+    def instruments(self) -> List[Tuple[str, object]]:
+        """Sorted ``(name, instrument)`` pairs — the typed view the tsdb
+        sampler reads (histograms keep their ``cumulative()`` buckets,
+        which ``snapshot()`` flattens away)."""
+        with self._lock:
+            return sorted(self._instruments.items())
+
     # -- export ------------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time ``{name: value}`` dict (histograms expand to their

@@ -186,6 +186,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         owner: "TelemetryServer" = self.server.owner  # type: ignore
         path = self.path.split("?", 1)[0]
+        t0 = time.perf_counter()
+        endpoint = owner._endpoint_slug(path)
+        failed = False
         try:
             if path == "/metrics":
                 self._send(200, owner.metrics_body().encode("utf-8"),
@@ -195,15 +198,29 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, body)
             elif path == "/snapshot":
                 self._send_json(200, owner.snapshot())
+            elif path in owner._routes:
+                code, body = owner.route_body(path)
+                self._send_json(code, body)
             else:
                 self._send_json(404, {"error": f"no route {path}",
                                       "routes": ["/metrics", "/healthz",
-                                                 "/snapshot"]})
+                                                 "/snapshot",
+                                                 *sorted(owner._routes)]})
         except Exception as e:  # a broken provider must not kill the server
+            failed = True
             try:
                 self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             except Exception:
                 pass
+        # scrape self-observability (a monitoring plane that cannot see
+        # its own scrapes repeats the PR 11 silent-parse-failure lesson):
+        # per-endpoint request/error counters + one shared duration
+        # histogram on the SAME registry this surface exposes
+        try:
+            owner._observe_scrape(endpoint, time.perf_counter() - t0,
+                                  failed)
+        except Exception:
+            pass  # self-accounting must never break a scrape
 
 
 class TelemetryServer:
@@ -235,6 +252,7 @@ class TelemetryServer:
         self._snapshot_events = snapshot_events
         self._checks: List[Tuple[str, Callable[[], Any]]] = []
         self._extra_snapshot: Dict[str, Callable[[], Any]] = {}
+        self._routes: Dict[str, Callable[[], Any]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._t0 = clock()
@@ -292,6 +310,21 @@ class TelemetryServer:
         self._extra_snapshot[name] = fn
         return self
 
+    def add_route(self, path: str, fn: Callable[[], Any]
+                  ) -> "TelemetryServer":
+        """Register an extra GET route serving JSON: ``fn()`` returns
+        either a JSON-representable body (→ 200) or a ``(status_code,
+        body)`` tuple. The built-in three routes cannot be shadowed —
+        their contracts are load-bearing (router/probe/scraper). Wire
+        routes before :meth:`start` (the handler reads the table from
+        its own threads)."""
+        if not path.startswith("/"):
+            raise ValueError(f"route must start with '/', got {path!r}")
+        if path in ("/metrics", "/healthz", "/snapshot"):
+            raise ValueError(f"route {path} is built in")
+        self._routes[path] = fn
+        return self
+
     # -- endpoint bodies (exercised directly by unit tests) ----------------
     def health(self) -> Tuple[int, Dict[str, Any]]:
         """(status_code, body) for ``/healthz``: 200 iff every check
@@ -344,6 +377,51 @@ class TelemetryServer:
                                 health=body, registry=self.registry,
                                 tracer=self.tracer)
         return (200 if ok else 503), body
+
+    def route_body(self, path: str) -> Tuple[int, Any]:
+        """(status_code, body) for a registered extra route."""
+        res = self._routes[path]()
+        if isinstance(res, tuple) and len(res) == 2 \
+                and isinstance(res[0], int):
+            return res
+        return 200, res
+
+    # -- scrape self-observability -----------------------------------------
+    _KNOWN_ENDPOINTS = ("metrics", "healthz", "snapshot")
+
+    def _endpoint_slug(self, path: str) -> str:
+        """Bounded-cardinality endpoint label for a request path. ONLY
+        an exactly-matched route earns its own counter — ``/healthz/``
+        404s, so counting it as ``healthz`` would mask exactly the
+        misconfigured-probe case the counters exist to expose; it and
+        every other unmatched path land on ``other``. Route names are
+        sanitized to the metric-name grammar (``/my-route`` mints
+        ``scrape_requests_my_route_total``, not a ValueError that skips
+        the accounting)."""
+        name = path.lstrip("/")
+        if not (name in self._KNOWN_ENDPOINTS and path == f"/{name}") \
+                and path not in self._routes:
+            return "other"
+        name = "".join(c if (c.isalnum() and c.isascii()) or c == "_"
+                       else "_" for c in name.replace("/", "_"))
+        if not name or name[0].isdigit():
+            name = f"r_{name}"
+        return name
+
+    def _observe_scrape(self, endpoint: str, dur_s: float,
+                        failed: bool) -> None:
+        reg = self.registry
+        reg.counter("scrape_requests_total",
+                    "telemetry HTTP requests served").inc()
+        reg.counter(f"scrape_requests_{endpoint}_total",  # dcnn: metric=scrape_requests_*_total
+                    f"telemetry requests served on /{endpoint}").inc()
+        if failed:
+            reg.counter("scrape_errors_total",
+                        "telemetry HTTP requests that failed (500)").inc()
+            reg.counter(f"scrape_errors_{endpoint}_total",  # dcnn: metric=scrape_errors_*_total
+                        f"failed telemetry requests on /{endpoint}").inc()
+        reg.histogram("scrape_duration_seconds",
+                      "wall per telemetry HTTP request").observe(dur_s)
 
     def metrics_body(self) -> str:
         """The ``/metrics`` body: refreshes the tracer's saturation

@@ -279,6 +279,14 @@ def inspect_bundle(path: str) -> Dict[str, Any]:
                               "reasons": h.get("reasons")}
         except (OSError, ValueError) as e:
             out["healthz_error"] = str(e)
+    # tsdb history window (obs/tsdb.py): the minutes BEFORE the trigger
+    tpath = os.path.join(path, "history.jsonl")
+    if os.path.isfile(tpath):
+        try:
+            from .tsdb import summarize_history
+            out["history"] = summarize_history(tpath)
+        except (OSError, ValueError) as e:
+            out["history_error"] = str(e)
     return out
 
 

@@ -9,13 +9,14 @@ decision loop, deliberately boring where it matters:
 - **Signals come off the scrape surface, not private objects.** Each
   tick reads every replica's Prometheus exposition text (in-process via
   ``replica.metrics.prometheus()``, or over HTTP via
-  :class:`HttpScraper`) and feeds it through
-  :func:`~dcnn_tpu.obs.exposition.parse_prometheus_text` — the
-  autoscaler's only contract with a replica is the same text an external
-  Prometheus reads (queue depth, windowed p99, shed fraction, HBM
-  watermark gauges). Router-level shed/offered counters are read as
-  per-tick deltas so the breach verdict tracks *current* traffic, not
-  history.
+  :class:`~dcnn_tpu.obs.fleet.HttpScraper`) through the shared
+  :class:`~dcnn_tpu.obs.fleet.FleetAggregator` — the autoscaler's only
+  contract with a replica is the same text an external Prometheus reads
+  (queue depth, windowed p99, shed fraction, HBM watermark gauges), and
+  the aggregator retains the per-replica + sum/max history in its tsdb
+  while counting per-target scrape latency/failures. Router-level
+  shed/offered counters are read as per-tick deltas so the breach
+  verdict tracks *current* traffic, not history.
 - **Deterministic and injectable-clock.** :meth:`Autoscaler.tick` is one
   pure decision turn; tests drive the whole diurnal soak sleep-free
   under a fake clock (the ModelVersionManager pattern). Production runs
@@ -52,15 +53,15 @@ each breach episode records breach-start → capacity-added on
 
 from __future__ import annotations
 
-import json
 import threading
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..obs.exposition import parse_prometheus_text, scalar_values
+# HttpScraper moved to obs/fleet.py with the monitoring plane; imported
+# here so `from dcnn_tpu.serve.autoscale import HttpScraper` keeps
+# working (it predates the fleet tier and is the documented name)
+from ..obs.fleet import FleetAggregator, HttpScraper  # noqa: F401
 from .router import Router
 
 
@@ -145,49 +146,6 @@ class FleetSignals:
     shed_fraction: float = 0.0
     offered: float = 0.0             # requests offered since last tick
     hbm_fraction: Optional[float] = None
-
-
-class HttpScraper:
-    """Scrape callable over real replica telemetry endpoints (the
-    production wiring): ``scraper = HttpScraper({"r0": url, ...})``,
-    then ``Autoscaler(..., scrape=scraper)``. Fetches ``<url>/metrics``
-    exposition text with a hard timeout; a fetch failure returns ``None``
-    (the replica scores as signal-less — the router's own liveness
-    verdict still governs routability)."""
-
-    def __init__(self, urls: Dict[str, str], *, timeout_s: float = 2.0):
-        self.urls = dict(urls)
-        self.timeout_s = timeout_s
-
-    def healthz(self, name: str) -> Optional[Dict[str, Any]]:
-        """The parsed ``/healthz`` JSON body (any status code — a 503
-        carries the machine-readable degradation reasons), or ``None``
-        when unreachable."""
-        url = self.urls.get(name)
-        if url is None:
-            return None
-        try:
-            with urllib.request.urlopen(f"{url}/healthz",
-                                        timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode("utf-8"))
-        except urllib.error.HTTPError as e:
-            try:
-                return json.loads(e.read().decode("utf-8"))
-            except Exception:
-                return None
-        except Exception:
-            return None
-
-    def __call__(self, name: str, replica) -> Optional[str]:
-        url = self.urls.get(name)
-        if url is None:
-            return None
-        try:
-            with urllib.request.urlopen(f"{url}/metrics",
-                                        timeout=self.timeout_s) as r:
-                return r.read().decode("utf-8")
-        except Exception:
-            return None
 
 
 def _default_scrape(name: str, replica) -> Optional[str]:
@@ -404,7 +362,8 @@ class Autoscaler:
                  = _default_scrape,
                  registry=None,
                  clock: Callable[[], float] = time.monotonic,
-                 name: str = "autoscaler", flight=None):
+                 name: str = "autoscaler", flight=None,
+                 aggregator: Optional[FleetAggregator] = None):
         self.router = router
         self.factory = factory
         self.cfg = config if config is not None else AutoscalerConfig()
@@ -417,6 +376,13 @@ class Autoscaler:
         self._clock = clock
         self._reg = registry if registry is not None \
             else router.metrics.registry
+        # the ONE scrape surface (obs/fleet.py): every tick's replica
+        # expositions flow through the aggregator, which parses them,
+        # retains per-replica + sum/max fleet history in its tsdb, and
+        # counts per-target scrape latency/failures — the autoscaler
+        # keeps only the DECISION state (deltas, hysteresis runs)
+        self.aggregator = aggregator if aggregator is not None \
+            else FleetAggregator(registry=self._reg, clock=clock)
         self._lock = threading.Lock()
         self._owned: Dict[str, Any] = {}      # dcnn: guarded_by=_lock
         self._spawned = 0                     # dcnn: guarded_by=_lock
@@ -485,11 +451,14 @@ class Autoscaler:
 
     # -- signals -----------------------------------------------------------
     def collect(self, *, _commit: bool = False) -> FleetSignals:
-        """One scrape pass: per-replica exposition text → parsed signals
-        + the router's per-tick shed delta. Public calls are READ-ONLY:
-        only the decision loop commits the counter baseline (``_commit``)
-        — an operator dashboard polling ``collect()`` between ticks must
-        not consume the shed delta and blind the next tick's breach
+        """One scrape pass THROUGH the aggregator: per-replica exposition
+        text → parsed signals + the router's per-tick shed delta. The
+        aggregator does the scraping/parsing/history bookkeeping
+        (obs/fleet.py); this method reduces its results to the decision
+        signals. Public calls are READ-ONLY on decision state: only the
+        decision loop commits the counter baseline (``_commit``) — an
+        operator dashboard polling ``collect()`` between ticks must not
+        consume the shed delta and blind the next tick's breach
         verdict."""
         stats = self.router.replica_stats()
         fleet = FleetSignals()
@@ -497,23 +466,25 @@ class Autoscaler:
         hbms: List[float] = []
         handles = self.router.replicas()
         parse_errors: List[str] = []
+        scraped = self.aggregator.poll(targets={
+            rname: (lambda rn=rname: self.scrape(rn, handles.get(rn)))
+            for rname in stats})
         for rname, st in stats.items():
             sig = ReplicaSignals(name=rname,
                                  routable=st["state"] == "up")
-            text = self.scrape(rname, handles.get(rname))
-            if text:
-                try:
-                    vals = scalar_values(parse_prometheus_text(text))
-                except ValueError as e:
-                    # a half-parsed scrape must not feed the decision —
-                    # but it must not be INVISIBLE either: the replica
-                    # scores signal-less (a latency-only breach there
-                    # goes dark), so count it and degrade /healthz via
-                    # autoscale_check until a tick parses clean
-                    vals = {}
-                    parse_errors.append(f"{rname}: {e}")
-                    if _commit:
-                        self._scrape_failures.inc()
+            res = scraped.get(rname, {})
+            if res.get("parse_error"):
+                # a half-parsed scrape must not feed the decision — but
+                # it must not be INVISIBLE either: the replica scores
+                # signal-less (a latency-only breach there goes dark),
+                # so count it and degrade /healthz via autoscale_check
+                # until a tick parses clean
+                parse_errors.append(f"{rname}: {res['parse_error']}")
+                if _commit:
+                    self._scrape_failures.inc()
+            vals = res.get("values")
+            if res.get("fetched"):
+                vals = vals if vals is not None else {}
                 sig.queue_depth = float(vals.get("serve_queue_depth", 0.0))
                 sig.p99_ms = vals.get("serve_latency_window_p99_ms")
                 sig.shed_fraction = float(
@@ -893,6 +864,7 @@ class Autoscaler:
                 "blocked": self._blocked_reason,
                 "last_error": self._last_error,
                 "scrape_error": self._scrape_error,
+                "tsdb": self.aggregator.store.summary(),
             }
 
     # -- background polling (production convenience) -----------------------

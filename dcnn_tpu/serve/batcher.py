@@ -33,6 +33,7 @@ logic, not a test-only twin.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -114,6 +115,7 @@ class DynamicBatcher:
         self._closing = False
         self._thread: Optional[threading.Thread] = None
         self._telemetry = None  # TelemetryServer from start_telemetry()
+        self._tsdb = None  # TsdbSampler riding the telemetry lifecycle
         self._compile_mirrored = False  # engine compile counters copied
         # onto the scrape registry at most once
         if start:
@@ -195,10 +197,9 @@ class DynamicBatcher:
         server (stopped first — never a leaked bound port). Returns the
         started server."""
         from ..obs.server import TelemetryServer
+        from ..obs.tsdb import TimeSeriesStore, TsdbSampler
 
-        if self._telemetry is not None:
-            self._telemetry.stop()
-            self._telemetry = None
+        self._stop_telemetry()
         srv = TelemetryServer(registry=self.metrics.registry,
                               metrics_text=self.metrics.prometheus,
                               host=host, port=port)
@@ -245,8 +246,41 @@ class DynamicBatcher:
             "batch_invariant": self.engine.batch_invariant,
             "compile_stats": getattr(self.engine, "compile_stats", {}),
         })
+        # per-replica monitoring-plane history (obs/tsdb.py): THIS
+        # surface's own /metrics text sampled at a cadence for as long
+        # as it is up, so flight bundles carry the time-resolved serve
+        # series — text (not registry) sampling, because the windowed
+        # p99/shed-fraction gauges a postmortem wants exist only in
+        # ServeMetrics' rendered exposition
+        store = TimeSeriesStore()
+        self._tsdb = TsdbSampler(
+            store, registry=self.metrics.registry,
+            text_fn=self.metrics.prometheus,
+            interval_s=float(os.environ.get(
+                "DCNN_TSDB_INTERVAL", "1.0"))).start()
+        srv.add_snapshot("tsdb", store.summary)
+        # flight bundles from this process now carry the pre-trigger
+        # window (newest surface wins when several replicas share the
+        # process-global recorder; detach below is identity-guarded)
+        get_flight_recorder().attach_tsdb(store)
         self._telemetry = srv.start()
         return srv
+
+    def _stop_telemetry(self) -> None:
+        """Stop the scrape server AND its history sampler (idempotent —
+        called from every shutdown path and on re-start)."""
+        if self._tsdb is not None:
+            from ..obs.flight import get_flight_recorder
+            rec = get_flight_recorder()
+            # detach only OUR store: another replica's attachment (it
+            # started later, it wins) must survive this shutdown
+            if getattr(rec, "_tsdb", None) is self._tsdb.store:
+                rec.attach_tsdb(None)
+            self._tsdb.stop()
+            self._tsdb = None
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
 
     # -- dispatch core (shared by the thread and the synchronous step) --
     def _pop_due(self, force: bool) -> List[_Request]:
@@ -430,9 +464,7 @@ class DynamicBatcher:
             finally:
                 # even an expired drain (TimeoutError) must release the
                 # scrape port — a leaked server blocks the replica restart
-                if self._telemetry is not None:
-                    self._telemetry.stop()
-                    self._telemetry = None
+                self._stop_telemetry()
             return
         exc = ShutdownError("batcher shut down without drain")
         with self._cond:
@@ -457,9 +489,7 @@ class DynamicBatcher:
             self._thread.join(timeout)
             self._thread = None
         self._fail_pending(exc)  # sweep any remainder: no future orphaned
-        if self._telemetry is not None:
-            self._telemetry.stop()
-            self._telemetry = None
+        self._stop_telemetry()
 
     def __enter__(self) -> "DynamicBatcher":
         return self

@@ -224,4 +224,18 @@ def run_diurnal_soak(*, seconds: float = 240.0, period: float = 240.0,
         "final_fleet": sizes[-1],
         "shed": snap["serve_router_shed_normal_total"],
     }
+    # monitoring-plane evidence: every tick's collect() flowed through
+    # the scaler's FleetAggregator, so its tsdb holds the soak's
+    # time-resolved fleet history — summarized here so the
+    # BENCH_AUTOSCALE block carries it
+    from ..obs.tsdb import series_stats
+    store = scaler.aggregator.store
+    report["history"] = {
+        "series": len(store.series_names()),
+        "points": store.points(),
+        "p99_ms_max": series_stats(
+            store.range('serve_latency_window_p99_ms{fleet="max"}')),
+        "queue_depth_sum": series_stats(
+            store.range('serve_queue_depth{fleet="sum"}')),
+    }
     return report, scaler, router

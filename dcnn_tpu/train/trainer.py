@@ -303,6 +303,7 @@ class Trainer:
             self.checkpoints = None
         self.watchdog = None  # created per fit() when stall_timeout_s > 0
         self.telemetry = None  # TelemetryServer, per fit() (metrics_port)
+        self._tsdb = None      # TsdbSampler, per fit() (rides metrics_port)
         self._global_step = 0
         self.train_step = make_train_step(model, self.loss_fn, optimizer,
                                           self.config.num_microbatches,
@@ -635,6 +636,7 @@ class Trainer:
                 # still stop the watchdog below
                 from ..obs import (TelemetryServer, checkpoint_check,
                                    get_flight_recorder, watchdog_check)
+                from ..obs.tsdb import TimeSeriesStore, TsdbSampler
                 srv = TelemetryServer(registry=reg, tracer=tracer,
                                       port=cfg.metrics_port)
                 srv.set_identity(component="trainer")
@@ -646,11 +648,33 @@ class Trainer:
                     srv.add_check("checkpoint",
                                   checkpoint_check(self.checkpoints))
                 self.telemetry = srv.start()
+                # monitoring-plane history (obs/tsdb.py): sample the
+                # registry at a cadence for the whole fit, so flight
+                # bundles carry the minutes before a trigger and
+                # /snapshot shows the store's shape. Telemetry off =
+                # zero threads, zero per-step cost.
+                store = TimeSeriesStore()
+                self._tsdb = TsdbSampler(
+                    store, registry=reg,
+                    interval_s=float(os.environ.get(
+                        "DCNN_TSDB_INTERVAL", "1.0"))).start()
+                srv.add_snapshot("tsdb", store.summary)
+                get_flight_recorder().attach_tsdb(store)
                 print(f"telemetry: {srv.url}/metrics /healthz /snapshot",
                       flush=True)
             return self._fit_loop(ts, train_loader, val_loader, epochs,
                                   start_epoch, rng, best_val, tracer, reg)
         finally:
+            if self._tsdb is not None:
+                # detach OUR store only: a later bundle must not dump
+                # this dead run's frozen history as if it were current,
+                # but another component's newer attachment must survive
+                from ..obs import get_flight_recorder
+                rec = get_flight_recorder()
+                if getattr(rec, "_tsdb", None) is self._tsdb.store:
+                    rec.attach_tsdb(None)
+                self._tsdb.stop()
+                self._tsdb = None
             if self.telemetry is not None:
                 self.telemetry.stop()
                 self.telemetry = None

@@ -1,0 +1,492 @@
+"""Fleet-aggregation tests (dcnn_tpu/obs/fleet.py): render→parse→merge
+round trips over real multi-replica expositions, live ephemeral-port
+fleet endpoints, scrape self-observability, the autoscaler-on-aggregator
+contract, and the ISSUE-15 end-to-end proof — a 3-replica fleet under
+open-loop load with an injected latency fault, driven entirely on fake
+clocks (no sleeps)."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.obs.fleet import FleetAggregator, HttpScraper
+from dcnn_tpu.obs.flight import FlightRecorder
+from dcnn_tpu.obs.registry import MetricsRegistry
+from dcnn_tpu.obs.rules import RuleEngine
+from dcnn_tpu.obs.server import TelemetryServer
+from dcnn_tpu.obs.trace import inspect_bundle
+from dcnn_tpu.obs.tsdb import load_history
+from dcnn_tpu.serve.metrics import ServeMetrics
+from dcnn_tpu.serve.soak import (ManualClock, make_soak_replica_factory,
+                                 run_diurnal_soak)
+from dcnn_tpu.serve.traffic import open_loop
+
+
+def _get_json(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as r:
+            return r.getcode(), json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+# ------------------------------------------------- render→parse→aggregate
+
+def test_render_parse_aggregate_round_trip_real_expositions():
+    """Real multi-replica ServeMetrics expositions (the exact bytes
+    /metrics serves) through the aggregator: per-replica labeled series
+    + sum/max fleet merges equal the source snapshots."""
+    fc = ManualClock()
+    reps = {}
+    for name, n_completed in (("r0", 3), ("r1", 5)):
+        m = ServeMetrics(clock=fc)
+        for _ in range(n_completed):
+            m.record_submit(1)
+            fc.advance(0.010)
+            m.record_done(0.010)
+        m.record_queue_depth(n_completed)  # distinct per-replica gauge
+        reps[name] = m
+    agg = FleetAggregator(registry=MetricsRegistry(clock=fc), clock=fc)
+    for name, m in reps.items():
+        agg.add_target(name, scrape=m.prometheus)
+    res = agg.poll()
+    assert all(r["values"] is not None for r in res.values())
+    for name, m in reps.items():
+        snap = m.snapshot()
+        assert agg.store.latest(
+            f'serve_queue_depth{{replica="{name}"}}')[1] \
+            == snap["queue_depth"]
+    assert agg.store.latest('serve_queue_depth{fleet="sum"}')[1] == 8.0
+    assert agg.store.latest('serve_queue_depth{fleet="max"}')[1] == 5.0
+    doc = agg.fleet_doc()
+    row = doc["series"]["serve_queue_depth"]
+    assert row == {"replicas": {"r0": 3.0, "r1": 5.0},
+                   "sum": 8.0, "max": 5.0}
+
+
+def test_fleet_endpoints_over_live_ephemeral_servers():
+    """/fleet, /alerts and the roll-up /healthz served over real
+    ephemeral-port HTTP, scraping two live replica TelemetryServers (one
+    via in-process fast path, one via URL)."""
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.gauge("serve_queue_depth").set(2.0)
+    r2.gauge("serve_queue_depth").set(6.0)
+    s1 = TelemetryServer(registry=r1).start()
+    s2 = TelemetryServer(registry=r2).start()
+    freg = MetricsRegistry()
+    agg = FleetAggregator(registry=freg)
+    eng = RuleEngine(agg.store, registry=freg)
+    eng.add_alert(name="deep", series='serve_queue_depth{fleet="max"}',
+                  op=">", threshold=4.0, for_s=0.0, window_s=60.0)
+    agg.rules = eng
+    agg.add_target("r1", server=s1)
+    agg.add_target("r2", url=s2.url)
+    try:
+        agg.poll()
+        fsrv = agg.serve()
+        code, fleet = _get_json(f"{fsrv.url}/fleet")
+        assert code == 200
+        assert fleet["targets"]["r1"]["up"] and fleet["targets"]["r2"]["up"]
+        assert fleet["series"]["serve_queue_depth"]["sum"] == 8.0
+        assert fleet["polls"] == 1
+        code, alerts = _get_json(f"{fsrv.url}/alerts")
+        assert code == 200 and alerts["firing"] == ["deep"]
+        code, health = _get_json(f"{fsrv.url}/healthz")
+        assert code == 503
+        assert any("deep" in r for r in health["reasons"])
+        # per-rule alert_state series ride the fleet /metrics exposition
+        with urllib.request.urlopen(f"{fsrv.url}/metrics") as r:
+            text = r.read().decode("utf-8")
+        assert 'alert_state{rule="deep"} 2' in text
+        # 404 body lists the fleet routes
+        try:
+            urllib.request.urlopen(f"{fsrv.url}/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert "/fleet" in json.loads(e.read())["routes"]
+    finally:
+        agg.close()
+        s1.stop()
+        s2.stop()
+
+
+def test_half_dead_target_is_visible():
+    """A target that stops answering (or serves garbage) must surface on
+    counters, the up-series, and the health roll-up — the PR 11
+    silent-parse-failure lesson at fleet scope."""
+    freg = MetricsRegistry()
+    agg = FleetAggregator(registry=freg)
+    state = {"text": "ok_total 1\n"}
+    agg.add_target("good", scrape=lambda: "g 1\n")
+    agg.add_target("flaky", scrape=lambda: state["text"])
+    agg.poll()
+    assert agg.health_rollup() is None
+    state["text"] = None                       # target goes dark
+    agg.poll()
+    assert freg.snapshot()["fleet_scrape_errors_total"] == 1
+    assert agg.store.latest('fleet_target_up{replica="flaky"}')[1] == 0.0
+    assert agg.store.latest('fleet_target_up{replica="good"}')[1] == 1.0
+    assert "flaky" in agg.health_rollup()
+    state["text"] = "torn{ garbage\n"          # now it half-answers
+    res = agg.poll()
+    assert res["flaky"]["parse_error"] is not None
+    assert freg.snapshot()["fleet_scrape_errors_total"] == 2
+    assert "flaky" in agg.health_rollup()
+    snap = freg.snapshot()
+    assert snap["fleet_targets"] == 2
+    assert snap["fleet_targets_up"] == 1
+    assert snap["fleet_scrape_seconds"]["count"] >= 6
+
+
+def test_unhealthy_target_degrades_rollup():
+    """A reachable target whose own /healthz is 503 degrades the fleet
+    roll-up with its reasons quoted."""
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg)
+    srv.add_check("stuck", lambda: "wedged on purpose")
+    srv.start()
+    agg = FleetAggregator(registry=MetricsRegistry())
+    agg.add_target("r0", server=srv)
+    try:
+        agg.poll()
+        rollup = agg.health_rollup()
+        assert rollup is not None and "wedged on purpose" in rollup
+    finally:
+        agg.close()
+        srv.stop()
+
+
+def test_scrape_self_observability_per_endpoint():
+    """TelemetryServer counts its own scrapes per endpoint: requests,
+    errors, and a shared duration histogram on the served registry."""
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg)
+    srv.add_route("/boom", lambda: (_ for _ in ()).throw(
+        RuntimeError("broken provider")))
+    srv.start()
+    try:
+        for _ in range(2):
+            urllib.request.urlopen(f"{srv.url}/metrics").read()
+        _get_json(f"{srv.url}/healthz")
+        try:
+            urllib.request.urlopen(f"{srv.url}/boom")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+        try:
+            urllib.request.urlopen(f"{srv.url}/unknown")
+        except urllib.error.HTTPError:
+            pass
+        snap = reg.snapshot()
+        assert snap["scrape_requests_metrics_total"] == 2
+        assert snap["scrape_requests_healthz_total"] == 1
+        assert snap["scrape_requests_boom_total"] == 1
+        assert snap["scrape_errors_boom_total"] == 1
+        assert snap["scrape_requests_other_total"] == 1
+        assert snap["scrape_requests_total"] == 5
+        assert snap["scrape_errors_total"] == 1
+        assert snap["scrape_duration_seconds"]["count"] == 5
+        # ...and the counters are visible on the NEXT scrape
+        with urllib.request.urlopen(f"{srv.url}/metrics") as r:
+            text = r.read().decode("utf-8")
+        assert "scrape_requests_metrics_total 2" in text
+    finally:
+        srv.stop()
+
+
+def test_http_scraper_reexport_and_add_route_guards():
+    # the pre-fleet import path must keep working
+    from dcnn_tpu.serve.autoscale import HttpScraper as FromAutoscale
+    assert FromAutoscale is HttpScraper
+    srv = TelemetryServer(registry=MetricsRegistry())
+    with pytest.raises(ValueError):
+        srv.add_route("no-slash", dict)
+    with pytest.raises(ValueError):
+        srv.add_route("/metrics", dict)
+    agg = FleetAggregator(registry=MetricsRegistry())
+    with pytest.raises(ValueError):
+        agg.add_target("x")                      # none of url/server/scrape
+    agg.add_target("x", scrape=lambda: None)
+    with pytest.raises(ValueError):
+        agg.add_target("x", scrape=lambda: None)  # duplicate
+    agg.remove_target("x")
+    assert agg.targets() == []
+
+
+def test_dynamic_targets_evict_from_last_poll_view():
+    """A replica that disappears from an explicit poll(targets=...) set
+    (the autoscaler scaled it away) ages out of /fleet and the health
+    roll-up instead of pinning a stale 'scrape failed' 503 forever."""
+    agg = FleetAggregator(registry=MetricsRegistry())
+    agg.poll(targets={"r0": lambda: "g 1\n", "r1": lambda: None})
+    assert "r1" in agg.health_rollup()          # half-dead while present
+    agg.poll(targets={"r0": lambda: "g 2\n"})   # r1 scaled away
+    assert agg.health_rollup() is None
+    assert set(agg.fleet_doc()["targets"]) == {"r0"}
+
+
+def test_health_rollup_reads_poll_cache_not_live(monkeypatch):
+    """The roll-up check must never fetch live — a slow target would
+    block every /healthz probe; the verdict comes from poll-time
+    cache."""
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg)
+    srv.add_check("stuck", lambda: "wedged")
+    srv.start()
+    agg = FleetAggregator(registry=MetricsRegistry())
+    agg.add_target("r0", server=srv)
+    try:
+        agg.poll()
+        monkeypatch.setattr(
+            agg, "_fetch_healthz",
+            lambda spec: (_ for _ in ()).throw(
+                AssertionError("roll-up must not fetch live")))
+        assert "wedged" in agg.health_rollup()
+    finally:
+        agg.close()
+        srv.stop()
+
+
+def test_replica_flight_bundles_carry_history(tmp_path):
+    """The batcher's telemetry wiring attaches its store to the flight
+    recorder: a serve-side bundle carries history.jsonl INCLUDING the
+    derived windowed gauges (p99/shed fraction — they exist only in the
+    rendered exposition, so the sampler reads the text contract), and
+    shutdown detaches only its own store."""
+    import numpy as np
+
+    from dcnn_tpu.obs.flight import get_flight_recorder
+    from dcnn_tpu.serve.batcher import DynamicBatcher
+    from dcnn_tpu.serve.soak import SyntheticEngine
+
+    rec = get_flight_recorder()
+    old = rec.directory, rec._tsdb
+    rec.directory, rec._tsdb = str(tmp_path), None
+    batcher = DynamicBatcher(SyntheticEngine(), start=False)
+    try:
+        batcher.start_telemetry(port=0)
+        assert rec._tsdb is batcher._tsdb.store
+        batcher.submit(np.full((4,), 7, np.float32))
+        batcher.step(force=True)
+        batcher._tsdb.sample_once()         # deterministic pass
+        path = rec.record("healthz_degraded", reasons=["test"])
+        assert path is not None
+        assert "history.jsonl" in os.listdir(path)
+        _meta, series = load_history(os.path.join(path, "history.jsonl"))
+        assert "serve_latency_window_p99_ms" in series
+        assert "serve_shed_fraction" in series
+        assert "serve_queue_depth" in series
+    finally:
+        batcher.shutdown(drain=False)
+        assert rec._tsdb is None            # detached its own store
+        rec.directory, rec._tsdb = old
+
+
+def test_dead_target_costs_one_timeout_no_healthz_fetch(monkeypatch):
+    """A target whose metrics fetch failed is NOT probed for /healthz
+    too — one dead host costs one timeout, and (with >1 target) fetches
+    run concurrently so the pass stays on cadence."""
+    agg = FleetAggregator(registry=MetricsRegistry())
+    agg.add_target("dead", url="http://127.0.0.1:9")   # discard port
+    agg.add_target("live", scrape=lambda: "g 1\n")
+    monkeypatch.setattr(
+        agg, "_fetch_healthz",
+        lambda spec: (_ for _ in ()).throw(
+            AssertionError("healthz must not be fetched for a dead "
+                           "target")))
+    res = agg.poll()
+    assert res["live"]["values"] == {"g": 1.0}
+    assert not res["dead"]["fetched"]
+    assert "dead" in agg.health_rollup()
+
+
+def test_trailing_slash_counts_as_other_not_endpoint():
+    """/healthz/ 404s, so it must land on the `other` counter — counting
+    it as healthz would mask the misconfigured probe the self-obs
+    counters exist to expose."""
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg).start()
+    try:
+        try:
+            urllib.request.urlopen(f"{srv.url}/healthz/")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        snap = reg.snapshot()
+        assert snap.get("scrape_requests_healthz_total", 0) == 0
+        assert snap["scrape_requests_other_total"] == 1
+    finally:
+        srv.stop()
+
+
+def test_hyphenated_route_slug_mints_valid_counters():
+    reg = MetricsRegistry()
+    srv = TelemetryServer(registry=reg)
+    srv.add_route("/my-route", lambda: {"ok": True})
+    srv.start()
+    try:
+        _get_json(f"{srv.url}/my-route")
+        snap = reg.snapshot()
+        assert snap["scrape_requests_my_route_total"] == 1
+        assert snap["scrape_requests_total"] == 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------- autoscaler-on-aggregator
+
+def test_autoscaler_reads_through_aggregator():
+    """Autoscaler.collect is the aggregator's poll: per-replica history
+    lands in the scaler's tsdb and the soak gates hold unchanged (the
+    equivalence pin for the refactor)."""
+    report, scaler, router = run_diurnal_soak()
+    # the PR 11 gates, verbatim
+    assert report["silently_dropped"] == 0
+    assert report["availability"] >= 0.999, report
+    assert report["scale_ups"] >= 2, report
+    # the new monitoring-plane evidence
+    store = scaler.aggregator.store
+    assert store.points() > 0
+    assert any(k.startswith("serve_latency_window_p99_ms{replica=")
+               for k in store.series_names())
+    assert store.latest('serve_queue_depth{fleet="sum"}') is not None
+    hist = report["history"]
+    assert hist["series"] > 0 and hist["points"] > 0
+    assert hist["p99_ms_max"]["points"] > 0
+    snap = router.metrics.registry.snapshot()
+    assert snap["fleet_polls_total"] > 0
+    assert snap["fleet_scrape_requests_total"] > 0
+
+
+# ------------------------------------------------------- the ISSUE-15 e2e
+
+def test_e2e_three_replica_fleet_latency_fault_alert_lifecycle(tmp_path):
+    """The acceptance proof: a 3-replica in-process fleet under open-loop
+    load with an injected latency fault — the p99 alert transitions
+    pending→firing within its for_s budget, the fleet /healthz degrades
+    naming the rule, an alert_firing flight bundle lands carrying the
+    pre-trigger history window, /fleet serves the merged labeled series,
+    and removing the fault resolves the alert. Entirely sleep-free."""
+    fc = ManualClock()
+    # window=32: the overload ages out of each replica's p99 within a
+    # few dozen recovery completions
+    factory = make_soak_replica_factory(fc, queue_capacity=64,
+                                        window=32)
+    state = {"slow": False}
+    replicas = [factory(1) for _ in range(3)]
+
+    def pump_all():
+        for rep in replicas:
+            try:
+                rep.step(force=True)
+            except Exception:
+                pass
+
+    class RoundRobin:
+        """Symmetric fan-out (the fleet under test is the monitoring
+        plane, not the router's SLO-aware placement — which would
+        deliberately starve a slow replica and keep its stale window
+        pinned)."""
+
+        def __init__(self):
+            self.i = 0
+
+        def submit(self, x):
+            self.i += 1
+            return replicas[self.i % len(replicas)].submit(x)
+
+    router = RoundRobin()
+
+    freg = MetricsRegistry(clock=fc)
+    fl = FlightRecorder(str(tmp_path), registry=freg, clock=fc,
+                        min_interval_s=0.0)
+    agg = FleetAggregator(registry=freg, clock=fc)
+    fl.attach_tsdb(agg.store)
+    eng = RuleEngine(agg.store, registry=freg, flight=fl, clock=fc)
+    FOR_S, TICK = 3.0, 1.0
+    eng.add_alert(name="fleet_p99_slo",
+                  series='serve_latency_window_p99_ms{fleet="max"}',
+                  op=">", threshold=200.0, for_s=FOR_S, window_s=30.0,
+                  description="fleet p99 over SLO")
+    agg.rules = eng
+    for rep in replicas:
+        agg.add_target(rep.name, scrape=rep.metrics.prometheus)
+    fsrv = agg.serve()
+    try:
+        # -- open-loop load; the fault slows SERVICE (pump cadence), so
+        # measured latency rises while traffic keeps arriving
+        state_t = {"next_pump": 0.0, "next_poll": 0.0}
+        alert_log = []
+
+        def drive_sleep(dt):
+            t_end = fc.t + dt
+            while fc.t < t_end:
+                nxt = min(t_end, state_t["next_pump"],
+                          state_t["next_poll"])
+                if fc.t < nxt:
+                    fc.advance(nxt - fc.t)
+                if fc.t >= state_t["next_pump"]:
+                    pump_all()
+                    state_t["next_pump"] += (0.8 if state["slow"]
+                                             else 0.05)
+                if fc.t >= state_t["next_poll"]:
+                    agg.poll()
+                    st = eng.alerts()[0]
+                    if not alert_log or alert_log[-1][1] != st["state"]:
+                        alert_log.append((fc.t, st["state"]))
+                    state_t["next_poll"] += TICK
+
+        samples = [np.full((4,), 7, np.float32)]
+        open_loop(router, samples, 40.0, 10.0, clock=fc,
+                  sleep=drive_sleep)            # healthy phase
+        assert eng.alerts()[0]["state"] == "inactive"
+        state["slow"] = True                    # inject the latency fault
+        open_loop(router, samples, 40.0, 12.0, clock=fc,
+                  sleep=drive_sleep)
+        # -- pending→firing within the for_s budget
+        states = [s for _, s in alert_log]
+        assert "pending" in states and "firing" in states
+        t_pending = next(t for t, s in alert_log if s == "pending")
+        t_firing = next(t for t, s in alert_log if s == "firing")
+        assert FOR_S <= t_firing - t_pending <= FOR_S + 2 * TICK, alert_log
+        assert eng.alerts()[0]["state"] == "firing"
+        # -- fleet /healthz degrades naming the rule
+        code, health = _get_json(f"{fsrv.url}/healthz")
+        assert code == 503
+        assert any("fleet_p99_slo" in r for r in health["reasons"])
+        # -- /fleet serves the merged labeled series for all 3 replicas
+        code, fleet = _get_json(f"{fsrv.url}/fleet")
+        assert code == 200
+        row = fleet["series"]["serve_latency_window_p99_ms"]
+        assert set(row["replicas"]) == {r.name for r in replicas}
+        assert row["max"] > 200.0
+        code, alerts = _get_json(f"{fsrv.url}/alerts")
+        assert alerts["firing"] == ["fleet_p99_slo"]
+        # -- the alert_firing bundle carries the pre-trigger history
+        bundles = fl.bundles()
+        assert [b["trigger"] for b in bundles] == ["alert_firing"]
+        bpath = bundles[0]["path"]
+        extra = json.load(open(os.path.join(bpath, "extra.json")))
+        window_ts = [t for t, _ in extra["window"]]
+        assert window_ts and min(window_ts) < t_firing  # BEFORE the page
+        _meta, series = load_history(os.path.join(bpath, "history.jsonl"))
+        assert any(k.startswith("serve_latency_window_p99_ms{replica=")
+                   for k in series)
+        assert inspect_bundle(bpath)["history"]["series"] > 0
+        # -- removing the fault resolves the alert and heals /healthz
+        state["slow"] = False
+        open_loop(router, samples, 40.0, 30.0, clock=fc,
+                  sleep=drive_sleep)
+        assert eng.alerts()[0]["state"] == "inactive", alert_log
+        assert eng.alerts()[0]["resolved_total"] == 1
+        code, _health = _get_json(f"{fsrv.url}/healthz")
+        assert code == 200
+    finally:
+        agg.close()
+        for rep in replicas:
+            try:
+                rep.close()
+            except Exception:
+                pass

@@ -27,7 +27,10 @@
 #      conformance over the four framed-TCP surfaces (PR01 handler
 #      exhaustiveness, PR02 generation/nonce fencing), and atomicity
 #      (AT01) against the committed baseline (docs/static_analysis.md).
-#      Zero unsuppressed findings required.
+#      Zero unsuppressed findings required. The monitoring-plane modules
+#      (obs/tsdb.py sampler thread -> CC02 lifecycle + AT01 persistence,
+#      obs/rules.py edge state + obs/fleet.py poll thread -> CC01
+#      guarded_by) are covered with zero baseline entries.
 #   3. coverage lints (full runs only — they span tests/ and docs/):
 #      --fault-coverage (every FaultPlan trip point armed by a test) and
 #      --metric-drift (obs.registry emissions <-> docs/observability.md,
