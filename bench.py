@@ -78,7 +78,13 @@ augment + pack off the producer thread; per-worker prep spans and
 prep_img_per_sec land under streaming_timeline.worker_prep),
 BENCH_FEED_AUGMENT=1 to add host augmentation (flip+crop) to the streaming
 feed so the prep measurement exercises the full gather+augment+pack path
-(tuning guide: docs/performance.md), BENCH_FAULTS=1 for
+(tuning guide: docs/performance.md), BENCH_WIRE=0 to skip the
+uint8-first feed-wire block (default on — emitted under a "feed_wire"
+key: wire_bytes_per_image, effective vs logical-f32 H2D rate, and
+per-codec compression ratios for the selectable wire codecs
+zlib/zstd/lz4/shuffle-lz4/shuffle-zstd over image-u8 and grad-f32
+payloads; wire_bytes_per_image and streaming_img_per_sec are
+regression-gated via dcnn_tpu/obs/regress.py), BENCH_FAULTS=1 for
 the checkpoint save/restore overhead probe (dcnn_tpu/resilience/; knob
 BENCH_FAULTS_REPS — emitted under a "resilience" key: sync save wall,
 async save's step-loop cost, verified-restore wall, plus an "elastic"
@@ -398,8 +404,10 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
         h2d_gbps = probe.nbytes / (time.perf_counter() - t0) / 1e9
 
         cdt = get_compute_dtype() or jnp.float32
+        # multiply-by-reciprocal form: the wire contract's canonical decode
+        # (data/wire.py) — division differs by 1 ulp via double rounding
         decode = jax.jit(lambda xu, yi: (
-            xu.astype(cdt) / np.asarray(255.0, cdt),
+            xu.astype(cdt) * np.asarray(np.float32(1.0 / 255.0), cdt),
             jax.nn.one_hot(yi, 200, dtype=jnp.float32)))
         # BENCH_FEED_WORKERS>0: the producer's gather+collate runs on the
         # shared-memory worker pool (data/workers.py) instead of the
@@ -540,7 +548,18 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
                 for e in tl for c in e["chunks"]],
             "inflight_max": max((e["inflight_max"] for e in tl), default=0),
             "h2d_gbps_effective": (round(fed_bytes / put_union / 1e9, 3)
-                                   if put_union > 0 else None)}
+                                   if put_union > 0 else None),
+            # uint8-first wire accounting (docs/performance.md §5):
+            # wire_bytes_per_image counts what actually crossed H2D per
+            # sample (images + labels as shipped); logical_gbps rates the
+            # float32-equivalent payload (images at 4 bytes/px, labels
+            # as-is) over the same put union — the "how fast does this
+            # LOOK to the f32 consumer" number, ~4x the effective rate
+            # on a uint8 wire
+            "wire_bytes_per_image": round(fed_bytes / n_s, 2),
+            "logical_gbps": (round(
+                (fed_bytes - xs_host.nbytes + 4 * xs_host.nbytes)
+                / put_union / 1e9, 3) if put_union > 0 else None)}
         preps = [e["prep"] for e in tl if "prep" in e]
         if preps:
             # host-side shard-prep accounting from the pool's per-worker
@@ -581,6 +600,62 @@ def run_config(batch, steps, reps, data_format, profile_dir=None, chunk=1,
     return (img_per_sec, dt / steps, train_flops / 1e12, pipeline_img_per_sec,
             h2d_gbps, resident_img_per_sec, streaming_img_per_sec, overlap_eff,
             phases, streaming_timeline)
+
+
+def feed_wire_section(streaming_timeline):
+    """uint8-first feed-wire evidence (docs/performance.md §5): the wire
+    accounting the streaming epoch measured (bytes actually shipped per
+    image, effective vs logical-f32 H2D rate) plus per-codec compression
+    ratios over two representative payloads — a spatially correlated
+    uint8 image shard (the feed wire) and a small-magnitude float32
+    gradient block (the elastic grad exchange) — each round-tripped
+    through the MetaCompressor tensor framing and verified bit-equal
+    before the ratio is trusted. Codecs whose native backend is absent
+    report ``{"available": False}`` instead of a fabricated number."""
+    import numpy as np
+
+    from dcnn_tpu.utils.compression import MetaCompressor, resolve_codec
+
+    rng = np.random.default_rng(11)
+    # smooth ramp + bounded noise: correlated like a real image — pure rng
+    # noise is incompressible and would read every codec as ratio 1.0
+    ramp = np.linspace(0.0, 255.0, 64 * 64,
+                       dtype=np.float32).reshape(64, 64)
+    img = (ramp[None, :, :, None]
+           + rng.integers(-8, 9, size=(32, 64, 64, 3)).astype(np.float32))
+    img_u8 = np.clip(img, 0.0, 255.0).astype(np.uint8)
+    grad_f32 = rng.standard_normal((256, 1024)).astype(np.float32) * 1e-3
+    mc = MetaCompressor()
+    codecs = {}
+    for name in ("zlib", "zstd", "lz4", "shuffle-lz4", "shuffle-zstd"):
+        try:
+            codec = resolve_codec(name)
+        except RuntimeError:
+            codecs[name] = {"available": False}
+            continue
+        entry = {"available": True}
+        for key, arr in (("image_u8", img_u8), ("grad_f32", grad_f32)):
+            t0 = time.perf_counter()
+            wire = mc.compress_array(arr, codec=codec)
+            dt = time.perf_counter() - t0
+            back = mc.decompress_array(wire)
+            if back.dtype != arr.dtype or not np.array_equal(back, arr):
+                raise AssertionError(
+                    f"wire codec {name} round-trip mismatch on {key}")
+            entry[f"{key}_ratio"] = round(arr.nbytes / len(wire), 3)
+            entry[f"{key}_compress_mbps"] = (round(arr.nbytes / dt / 1e6, 1)
+                                             if dt > 0 else None)
+        codecs[name] = entry
+    tl = streaming_timeline or {}
+    return {
+        # the wire contract: every feed path ships uint8, decode (cast +
+        # scale by 1/255) runs on device after the put
+        "wire_dtype": "uint8",
+        "wire_bytes_per_image": tl.get("wire_bytes_per_image"),
+        "h2d_gbps_effective": tl.get("h2d_gbps_effective"),
+        "logical_gbps": tl.get("logical_gbps"),
+        "codecs": codecs,
+    }
 
 
 def int8_inference_section(data_format: str):
@@ -1514,6 +1589,11 @@ def main() -> None:
             out["infer_int8_img_per_sec"] = round(int8_ips, 1)
             out["int8_speedup_x"] = round(int8_ips / bf16_ips, 3)
 
+    # uint8-first feed wire: measured wire bytes/rates + per-codec ratios
+    # (default-on — sub-second; BENCH_WIRE=0 opts out)
+    if os.environ.get("BENCH_WIRE", "1") == "1":
+        out["feed_wire"] = feed_wire_section(streaming_timeline)
+
     # online serving: latency-vs-offered-load curve through the dynamic
     # batcher (opt-in — real open-loop traffic adds ~3x
     # BENCH_SERVE_SECONDS of wall per run)
@@ -1581,6 +1661,9 @@ def main() -> None:
         "h2d_gbps": out.get("h2d_gbps"),
         "h2d_gbps_effective": (streaming_timeline or {}).get(
             "h2d_gbps_effective"),
+        "wire_bytes_per_image": (streaming_timeline or {}).get(
+            "wire_bytes_per_image"),
+        "logical_gbps": (streaming_timeline or {}).get("logical_gbps"),
         "train_step_bytes_per_flop": snap.get("train_step_bytes_per_flop"),
         "serve_flops_per_sample": snap.get("serve_flops_per_sample"),
     }

@@ -100,6 +100,12 @@ class TrainingConfig:
     elastic_min_world: int = 1        # fewer survivors than this aborts
                                       # (WorldCollapsedError) instead of
                                       # limping on
+    elastic_compress: str = ""        # frame codec for the grad-exchange
+                                      # mesh: "" = raw, or a name from
+                                      # utils/compression.resolve_codec
+                                      # ("lz4", "shuffle-lz4", "zstd",
+                                      # "shuffle-zstd", "zlib"). Per-frame
+                                      # codec ids keep mixed fleets interop
 
     # -- AOT executable cache (dcnn_tpu/aot; docs/performance.md) --
     aot_cache_dir: Optional[str] = None  # cache ROOT: warm-start the
@@ -168,6 +174,8 @@ class TrainingConfig:
                                        base.elastic_ckpt_steps),
             elastic_min_world=get_env("ELASTIC_MIN_WORLD",
                                       base.elastic_min_world),
+            elastic_compress=get_env("ELASTIC_COMPRESS",
+                                     base.elastic_compress),
             aot_cache_dir=get_env("AOT_CACHE",
                                   base.aot_cache_dir or "") or None,
             metrics_port=get_env("METRICS_PORT", base.metrics_port),

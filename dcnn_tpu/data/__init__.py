@@ -9,6 +9,10 @@ from .regression import RegressionDataLoader
 from .wifi import UJIWiFiDataLoader
 from .synthetic import SyntheticClassificationLoader
 from .prefetch import PrefetchLoader
+from .wire import (
+    WIRE_SCALE_U8, decode_batch, decode_host, default_decode_transform,
+    wire_scale,
+)
 from .streaming import (
     StreamingDeviceDataset, make_shard_step, train_streaming_epoch,
 )
@@ -35,6 +39,8 @@ __all__ = [
     "TinyImageNetDataLoader", "RegressionDataLoader", "UJIWiFiDataLoader",
     "SyntheticClassificationLoader",
     "PrefetchLoader",
+    "WIRE_SCALE_U8", "decode_batch", "decode_host",
+    "default_decode_transform", "wire_scale",
     "StreamingDeviceDataset", "make_shard_step", "train_streaming_epoch",
     "TransferEngine", "chunk_bounds", "max_inflight",
     "FeedWorkerPool", "LocalSlots", "PreparedShard", "ShmSlots",

@@ -5,7 +5,10 @@ Reference equivalent: ``CIFAR10DataLoader`` / ``CIFAR100DataLoader``
 ``cifar100_data_loader.hpp:37-105``). Format: records of
 ``[label_byte][3072 pixel bytes]`` (CIFAR-10) or
 ``[coarse_byte][fine_byte][3072 pixel bytes]`` (CIFAR-100), pixels stored
-plane-major R,G,B as 3×32×32, normalized by 255.
+plane-major R,G,B as 3×32×32. The reference normalizes by 255 at load;
+here pixels stay **uint8** — the on-disk bytes ARE the wire format (docs/
+performance.md §"The wire-dtype contract") and the consumer's decode
+multiplies by the loader's ``scale`` (1/255) after the put.
 """
 
 from __future__ import annotations
@@ -24,24 +27,19 @@ CIFAR10_CLASS_NAMES = ["airplane", "automobile", "bird", "cat", "deer",
 
 
 def _decode_file(path: str, skip_bytes: int, label_col: int):
-    """Decode one CIFAR binary file → (images NCHW f32/255, labels int64),
-    native fast path with numpy fallback."""
-    from .. import native
+    """Decode one CIFAR binary file → (images NCHW uint8, labels int64).
+
+    Pure record splitting — no float materialization: the pixel bytes go
+    to the wire untouched, 1/4 the host RAM of the old f32/255 load."""
     rec = skip_bytes + _IMG_BYTES
     if not os.path.isfile(path):
         raise FileNotFoundError(path)
     raw = np.fromfile(path, dtype=np.uint8)
     if len(raw) % rec != 0:
         raise ValueError(f"{path}: size {len(raw)} not a multiple of {rec}")
-    n = len(raw) // rec
-    decoded = native.decode_label_records(raw, n, skip_bytes, label_col,
-                                          _IMG_BYTES)
-    if decoded is not None:
-        x_f, lb = decoded
-        return x_f.reshape(-1, 3, 32, 32), lb.astype(np.int64)
     rows = raw.reshape(-1, rec)
-    return (rows[:, skip_bytes:].reshape(-1, 3, 32, 32).astype(np.float32)
-            / 255.0), rows[:, label_col].astype(np.int64)
+    return (rows[:, skip_bytes:].reshape(-1, 3, 32, 32),
+            rows[:, label_col].astype(np.int64))
 
 
 class CIFAR10DataLoader(BaseDataLoader):

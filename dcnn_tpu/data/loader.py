@@ -10,6 +10,18 @@ placement happens in the jitted step (the H2D boundary the reference hits at
 as vectorized numpy per-batch transforms at iteration time, so each epoch
 resamples them — same behavior as the reference's per-batch
 ``AugmentationStrategy`` hook.
+
+The wire-dtype contract (docs/performance.md §"The wire-dtype contract"):
+image loaders keep pixels **uint8** end-to-end on the host — batches cross
+the H2D (and TCP) wire as 1-byte pixels and the CONSUMER decodes with
+``x.astype(f32) * scale`` after the put (``data/wire.py``, the
+``make_batch_scan_body``/``make_shard_step`` scale path). ``wire_dtype`` /
+``scale`` on the loader are that contract's handshake: normalization lives
+nowhere in load or iteration — only in the decode the scale parameterizes.
+Host augmentation on a uint8 loader runs in float32 0..255 domain and
+re-quantizes (clip + round-half-even + cast), exactly the
+``workers.prepare_shard`` convention, so pooled and serial feeds stay
+bit-identical.
 """
 
 from __future__ import annotations
@@ -63,6 +75,24 @@ class BaseDataLoader:
     def num_samples(self) -> int:
         self._ensure_loaded()
         return len(self._x)
+
+    @property
+    def wire_dtype(self) -> np.dtype:
+        """Dtype of the batches this loader ships — what actually crosses
+        the H2D/TCP wire. uint8 for image loaders (1-byte pixels; the
+        consumer decodes), float32 for tabular/regression data."""
+        self._ensure_loaded()
+        return self._x.dtype
+
+    @property
+    def scale(self) -> float:
+        """Decode multiplier the consumer applies after the put:
+        ``decoded = x.astype(f32) * scale``. 1/255 for uint8 pixels, 1.0
+        for data already in model domain. The multiply form (not
+        ``x / 255``) is the contract — it is what the device ``_decode``
+        and the native kernels compute, bit-for-bit."""
+        self._ensure_loaded()
+        return 1.0 / 255.0 if self._x.dtype == np.uint8 else 1.0
 
     def shuffle(self, epoch: int) -> None:
         """Reshuffle ordering for a new epoch (reference
@@ -136,12 +166,25 @@ class BaseDataLoader:
         return self._x[sel], self._y[sel]
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        self._ensure_loaded()
         rng = self.epoch_rng()
+        requantize = self.augmentation is not None \
+            and self._x.dtype == np.uint8
         for take in self.batch_indices(rng):
             xb = self._x[take]
             yb = self._y[take]
             if self.augmentation is not None:
-                xb = self.augmentation(xb.copy(), rng)
+                if requantize:
+                    # uint8 wire: augment in float32 0..255 domain, then
+                    # clip + round-to-nearest back to exact uint8 — the
+                    # prepare_shard convention, so the pooled feed stays
+                    # bit-identical to this serial path
+                    xf = self.augmentation(xb.astype(np.float32), rng)
+                    np.clip(xf, 0.0, 255.0, out=xf)
+                    np.rint(xf, out=xf)
+                    xb = xf.astype(np.uint8)
+                else:
+                    xb = self.augmentation(xb.copy(), rng)
             yield xb, yb
 
 

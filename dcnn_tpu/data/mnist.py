@@ -2,8 +2,12 @@
 
 Reference equivalent: ``MNISTDataLoader``
 (``include/data_loading/mnist_data_loader.hpp:36-331``): CSV rows of
-``label,pix0..pix783`` (header skipped), pixels normalized by 255
-(NORMALIZATION_FACTOR, :27), shaped 1×28×28, labels one-hot 10.
+``label,pix0..pix783`` (header skipped), shaped 1×28×28, labels one-hot 10.
+The reference normalizes by 255 at load (NORMALIZATION_FACTOR, :27); here
+normalization moves to the consumer's decode — integer-pixel CSVs load as
+**uint8** (the wire dtype, docs/performance.md §"The wire-dtype contract")
+and ``scale`` on the loader carries the 1/255. Float-pixel CSVs (already
+normalized exports) stay float32 with ``scale`` 1.0.
 """
 
 from __future__ import annotations
@@ -27,19 +31,33 @@ class MNISTDataLoader(BaseDataLoader):
         if not os.path.isfile(self.csv_path):
             raise FileNotFoundError(self.csv_path)
         from .. import native
-        parsed = native.parse_label_csv(self.csv_path, 28 * 28)
+        # scale=1.0: the strict parser only accepts integer pixels 0..255,
+        # so the unscaled float is integer-exact and the uint8 cast below
+        # is lossless — 1-byte pixels from parse to wire
+        parsed = native.parse_label_csv(self.csv_path, 28 * 28, scale=1.0)
         if parsed is not None:
             pixels, labels = parsed
             labels = labels.astype(np.int64)
+            pixels = pixels.astype(np.uint8)
         else:
+            # tolerant numpy path; float32 load keeps the intermediate at
+            # 4 bytes/pixel (np.loadtxt default float64 doubled host RAM)
             raw = np.loadtxt(self.csv_path, delimiter=",", skiprows=1,
                              dtype=np.float32)
             if raw.ndim == 1:
                 raw = raw[None]
             labels = raw[:, 0].astype(np.int64)
-            pixels = raw[:, 1:] / 255.0
+            pix = raw[:, 1:]
+            if pix.size and np.all(pix == np.rint(pix)) \
+                    and pix.min() >= 0 and pix.max() <= 255:
+                pixels = pix.astype(np.uint8)
+            else:
+                # fractional pixels can't ride the uint8 wire: normalize
+                # here (float32 multiply — never the old float64-promoting
+                # `/ 255.0`) and ship model-domain floats, scale 1.0
+                pixels = pix * np.float32(1.0 / 255.0)
         imgs = pixels.reshape(-1, 1, 28, 28)
         if self.data_format == "NHWC":
             imgs = np.transpose(imgs, (0, 2, 3, 1))
-        self._x = np.ascontiguousarray(imgs, np.float32)
+        self._x = np.ascontiguousarray(imgs)
         self._y = one_hot(labels, self.NUM_CLASSES)

@@ -51,7 +51,11 @@ class PrefetchLoader:
     e.g. uint8→bf16 decode + normalize + one-hot. Shipping uint8 and casting
     on device cuts H2D bytes 4× vs fp32, which is the idiomatic TPU input
     recipe (and decisive on hosts where H2D bandwidth, not decode, bounds
-    feed rate).
+    feed rate). When the inner loader declares a uint8 wire
+    (``wire_dtype``/``scale``, the loader contract) and no
+    ``device_transform`` is given, the default ``wire.decode_batch``
+    transform is installed automatically: the put ships 1-byte pixels and
+    the yielded x is already ``float32 * scale`` — labels untouched.
     ``stage_batches=K`` stacks K batches per transfer, yielding [K, B, ...]
     device arrays for ``train.make_multi_step`` — the remote-TPU-friendly
     feeding mode (one H2D sync per K steps). With a ``sharding``, note the
@@ -99,6 +103,8 @@ class PrefetchLoader:
         self.sharding = sharding
         self.transform = transform
         self.device_transform = device_transform
+        self._auto_xform: Optional[Callable] = None
+        self._auto_xform_ready = False
         self.stage_batches = stage_batches
         self.transfer_engine = transfer_engine
         self.feed_workers = feed_workers
@@ -126,6 +132,31 @@ class PrefetchLoader:
     def shuffle(self, epoch: int) -> None:
         if hasattr(self.inner, "shuffle"):
             self.inner.shuffle(epoch)
+
+    @property
+    def wire_dtype(self):
+        """What this loader actually ships over the H2D wire — the inner
+        loader's wire dtype (the decode happens after the put here)."""
+        return getattr(self.inner, "wire_dtype", None)
+
+    @property
+    def scale(self):
+        return getattr(self.inner, "scale", 1.0)
+
+    def _device_xform(self) -> Optional[Callable]:
+        """The post-put transform: the explicit ``device_transform``, or —
+        for a uint8-wire inner with none given — the cached default
+        decode (lru-cached per scale; TS06 forbids a per-call closure)."""
+        if self.device_transform is not None:
+            return self.device_transform
+        if not self._auto_xform_ready:
+            wd = self.wire_dtype
+            if wd is not None and np.dtype(wd) == np.uint8:
+                from .wire import default_decode_transform
+                self._auto_xform = default_decode_transform(
+                    float(self.scale))
+            self._auto_xform_ready = True
+        return self._auto_xform
 
     # -- worker-pool delegation -------------------------------------------
     @property
@@ -257,8 +288,9 @@ class PrefetchLoader:
             dx, dy = self.transfer_engine.put_array(x), jax.device_put(y)
         else:
             dx, dy = jax.device_put(x), jax.device_put(y)
-        if self.device_transform is not None:
-            dx, dy = self.device_transform(dx, dy)
+        xform = self._device_xform()
+        if xform is not None:
+            dx, dy = xform(dx, dy)
         return dx, dy
 
     def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:

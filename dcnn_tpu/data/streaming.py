@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import native
-from ..obs import get_tracer
+from ..obs import get_registry, get_tracer
 from ..resilience import faults as _faults
 from .transfer import TransferEngine
 from .workers import FeedWorkerPool
@@ -353,6 +353,7 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                               daemon=True)
     worker.start()
     losses = []
+    fed_bytes = 0
     try:
         while True:
             t3 = time.perf_counter()
@@ -371,6 +372,7 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
                 ts, loss = step(ts, sx, sy, jax.random.fold_in(rng, i), lr)
             t5 = time.perf_counter()
             losses.append(loss)
+            fed_bytes += int(stats["bytes"])
             if timeline is not None:
                 entry = {
                     "shard": i, "gather_s": stats["gather_s"],
@@ -393,6 +395,20 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
             engine.close()
         if own_pool:
             pool.close()
+    # wire accounting: what actually crossed H2D this epoch, per image —
+    # the uint8-first wire contract's headline series (docs/performance.md
+    # §5; the regression gate tracks the bench mirror of this number).
+    # Shards are uniform (shard_selections yields shard_samples rows each),
+    # so images = consumed shards x shard_samples.
+    fed_images = len(losses) * int(getattr(dataset, "shard_samples", 0))
+    if fed_images:
+        reg = get_registry()
+        reg.gauge("feed_wire_bytes_per_image",
+                  "bytes shipped host-to-device per image, last streaming "
+                  "epoch").set(fed_bytes / fed_images)
+        reg.gauge("feed_wire_epoch_bytes",
+                  "total bytes shipped host-to-device, last streaming "
+                  "epoch").set(float(fed_bytes))
     # ONE on-device reduction + ONE readback: per-loss float() readbacks
     # measured ~3 s EACH on the tunnelled backend (13.6 s vs 0.41 s for a
     # 4-shard epoch) and were the r4 "overlap stalls at 0.40" culprit

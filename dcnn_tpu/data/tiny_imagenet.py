@@ -5,11 +5,13 @@ Reference equivalent: ``TinyImageNetDataLoader``
 ``wnids.txt`` (class ids), ``words.txt`` (names), train split from
 ``train/<wnid>/images/*.JPEG``, val split from ``val/images`` +
 ``val/val_annotations.txt``; JPEG decode via stb_image (PIL here), RGB,
-normalized by 255, 3×64×64.
+3×64×64. The reference normalizes by 255 at load; here pixels stay
+**uint8** — the wire dtype — and the consumer decodes with the loader's
+``scale`` after the put (docs/performance.md §"The wire-dtype contract").
 
 Decoding thousands of JPEGs on the host is the input-pipeline bottleneck for
 TPU feeding (SURVEY.md §7 hard part 5); this loader decodes once up front
-into a memory-resident float array (240 MB for the train split) and can
+into a memory-resident uint8 array (~60 MB for the train split) and can
 persist an ``.npz`` cache next to the dataset so later epochs/restarts skip
 decode entirely.
 """
@@ -101,7 +103,9 @@ class TinyImageNetDataLoader(BaseDataLoader):
                         os.unlink(tmp)
                     except OSError:
                         pass
-        x = x.astype(np.float32) / 255.0
+        # pixels stay uint8 — the wire dtype (decode happens after the
+        # put, parameterized by the loader's `scale`); a 200-class split
+        # drops from ~1.5 GB host f32 to ~380 MB
         x = np.transpose(x, (0, 3, 1, 2))  # HWC→CHW
         if self.data_format == "NHWC":
             x = np.transpose(x, (0, 2, 3, 1))

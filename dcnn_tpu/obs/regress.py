@@ -144,6 +144,22 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("pipeline.batches_lost", "resilience.pipeline.batches_lost",
                higher_is_better=False, tolerance=0.0,
                guard="resilience.pipeline.stages"),
+    # the uint8-first feed wire (ISSUE 16): bytes actually shipped
+    # host-to-device per image is a design invariant of the wire contract
+    # (uint8 + int labels — regrowing toward 4x/fp32 would be a feed-path
+    # regression, not noise), so its tolerance is tight. Pre-r06 captures
+    # lack the key and are skipped, not lied about.
+    MetricSpec("wire_bytes_per_image",
+               "streaming_timeline.wire_bytes_per_image",
+               higher_is_better=False, tolerance=0.05),
+    # streaming throughput is only comparable between captures that
+    # shipped the same bytes per image — a wire-dtype change re-baselines
+    # the feed, so the guard pins it; pre-r06 captures have no guard
+    # value and are skipped (skip-not-lie), exactly like the autoscale
+    # block's absent-metric semantics
+    MetricSpec("streaming_img_per_sec", "streaming_img_per_sec",
+               tolerance=0.3,
+               guard="streaming_timeline.wire_bytes_per_image"),
 )
 
 DEFAULT_TOLERANCE = 0.2

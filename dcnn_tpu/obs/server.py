@@ -191,36 +191,43 @@ class _Handler(BaseHTTPRequestHandler):
         failed = False
         try:
             if path == "/metrics":
-                self._send(200, owner.metrics_body().encode("utf-8"),
-                           CONTENT_TYPE)
-            elif path == "/healthz":
-                code, body = owner.health()
-                self._send_json(code, body)
-            elif path == "/snapshot":
-                self._send_json(200, owner.snapshot())
-            elif path in owner._routes:
-                code, body = owner.route_body(path)
-                self._send_json(code, body)
+                code, raw, ctype = (200, owner.metrics_body().encode(
+                    "utf-8"), CONTENT_TYPE)
             else:
-                self._send_json(404, {"error": f"no route {path}",
-                                      "routes": ["/metrics", "/healthz",
-                                                 "/snapshot",
-                                                 *sorted(owner._routes)]})
+                if path == "/healthz":
+                    code, body = owner.health()
+                elif path == "/snapshot":
+                    code, body = 200, owner.snapshot()
+                elif path in owner._routes:
+                    code, body = owner.route_body(path)
+                else:
+                    code, body = 404, {"error": f"no route {path}",
+                                       "routes": ["/metrics", "/healthz",
+                                                  "/snapshot",
+                                                  *sorted(owner._routes)]}
+                raw, ctype = (json.dumps(body, default=str).encode("utf-8"),
+                              "application/json")
         except Exception as e:  # a broken provider must not kill the server
             failed = True
-            try:
-                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
-            except Exception:
-                pass
+            code, ctype = 500, "application/json"
+            raw = json.dumps({"error": f"{type(e).__name__}: {e}"},
+                             default=str).encode("utf-8")
         # scrape self-observability (a monitoring plane that cannot see
         # its own scrapes repeats the PR 11 silent-parse-failure lesson):
         # per-endpoint request/error counters + one shared duration
-        # histogram on the SAME registry this surface exposes
+        # histogram on the SAME registry this surface exposes. Accounted
+        # BEFORE the bytes hit the wire: a client that has seen the
+        # response must find the scrape already counted — probes and
+        # tests legitimately race on exactly that edge.
         try:
             owner._observe_scrape(endpoint, time.perf_counter() - t0,
                                   failed)
         except Exception:
             pass  # self-accounting must never break a scrape
+        try:
+            self._send(code, raw, ctype)
+        except Exception:
+            pass  # peer gone mid-write: nothing useful to do
 
 
 class TelemetryServer:

@@ -22,8 +22,12 @@ Wire format (original, little-endian):
 Array payloads ride the ``MetaCompressor`` tensor framing
 (``utils/compression.py`` — rank + dims + dtype + data, codec-id header), so
 activation compression (reference's zstd path, declared-but-unwired there) is
-actually live here: ``Channel(compress=True)`` zstd-compresses any tensor
-payload, and the receiver dispatches by codec id without configuration.
+actually live here: ``Channel(compress=...)`` takes ``True`` (the
+``DCNN_WIRE_CODEC`` env codec, else the zstd default), a codec name
+(``"lz4"``, ``"shuffle-lz4"``, ``"shuffle-zstd"``, ...) or a
+``Compressor`` instance, and compresses every tensor payload with it. The
+receiver always dispatches by the per-frame codec id without
+configuration, so mixed-codec fleets interoperate frame by frame.
 """
 
 from __future__ import annotations
@@ -41,15 +45,15 @@ import numpy as np
 from ..obs.tracer import get_tracer
 from ..resilience import faults as _faults
 from ..resilience.retry import retry_call
-from ..utils.compression import MetaCompressor, RawCompressor
+from ..utils.compression import Compressor, MetaCompressor, resolve_codec
 
 MAGIC = 0x44544E31
 _HEADER = struct.Struct("<IBIQ")
 _FLAG_PAYLOAD = 1
 
-# module-level codec registry: raw for speed by default, zstd on request
+# module-level codec registry: raw for speed by default, the per-channel
+# resolved codec (resolve_codec) on request
 _CODEC = MetaCompressor()
-_RAW = RawCompressor()
 
 
 class ChannelClosed(ConnectionError):
@@ -71,11 +75,20 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 class Channel:
     """One bidirectional framed connection to a peer."""
 
-    def __init__(self, sock: socket.socket, compress: bool = False):
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(self, sock: socket.socket,
+                 compress: bool | str | Compressor = False):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # non-TCP stream (socketpair in tests): nothing to disable
         self._sock = sock
         self._send_lock = threading.Lock()
         self.compress = compress
+        # send-side codec, resolved once (selection may probe the native
+        # toolchain): None = the MetaCompressor default (zstd). The recv
+        # side needs no configuration — it dispatches on the frame's
+        # codec id.
+        self._codec = resolve_codec(compress)
         # set once sendall has raised: part of a frame may already be on
         # the wire, so the byte stream is unframeable — every later send
         # must fail fast rather than interleave a fresh frame
@@ -112,9 +125,8 @@ class Channel:
             m["_trace"] = ctx
         payload = b""
         if array is not None:
-            payload = _CODEC.compress_array(
-                np.asarray(array),
-                codec=None if self.compress else _RAW)
+            payload = _CODEC.compress_array(np.asarray(array),
+                                            codec=self._codec)
         elif raw is not None:
             payload = raw
             m["_raw"] = True
@@ -238,7 +250,7 @@ def listen(port: int, host: str = "0.0.0.0") -> socket.socket:
 
 
 def connect(host: str, port: int, *, timeout: float = 60.0,
-            delay: float = 0.2, compress: bool = False,
+            delay: float = 0.2, compress: bool | str | Compressor = False,
             sleep=time.sleep, clock=time.monotonic,
             name: str = "pipeline_connect") -> Channel:
     """Connect through the shared bounded-backoff primitive

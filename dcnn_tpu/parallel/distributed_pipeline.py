@@ -205,7 +205,7 @@ class DistributedPipelineCoordinator:
                  partitioner: Optional[Partitioner] = None,
                  num_microbatches: int = 4,
                  track_load: "bool | str" = False,
-                 compress: bool = False, timeout: float = 120.0,
+                 compress: "bool | str" = False, timeout: float = 120.0,
                  *, timeouts: Optional[PipelineTimeouts] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 8, checkpoint_keep: int = 3,

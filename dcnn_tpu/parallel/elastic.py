@@ -73,6 +73,7 @@ import jax
 import jax.flatten_util
 import jax.numpy as jnp
 
+from ..data import wire as _wire
 from ..obs import get_registry, get_tracer
 from ..resilience import faults as _faults
 from ..train.trainer import TrainState, create_train_state
@@ -166,9 +167,14 @@ class Membership:
                  listen_sock: Optional[socket.socket] = None,
                  heartbeat_s: float = 0.0, peer_timeout_s: float = 10.0,
                  clock: Callable[[], float] = time.monotonic,
-                 registry=None):
+                 registry=None, compress: bool | str = False):
         self.rank = rank
         self.peers = {p.rank: p for p in peers}
+        # frame codec for every mesh channel — False = raw, or a codec
+        # name ("lz4", "shuffle-lz4", ...; utils/compression.resolve_codec).
+        # Per-frame codec ids keep mixed fleets interoperable: a peer
+        # configured raw still decodes a compressed sender and vice versa.
+        self.compress = compress
         if rank not in self.peers:
             raise ValueError(f"rank {rank} not in peer list "
                              f"{sorted(self.peers)}")
@@ -207,7 +213,8 @@ class Membership:
                 continue
             p = self.peers[r]
             ch = connect(p.host, p.port,
-                         timeout=max(deadline - self._clock(), 1.0))
+                         timeout=max(deadline - self._clock(), 1.0),
+                         compress=self.compress)
             # t_mono: the acceptor estimates our perf_counter offset for
             # trace-shard alignment (python -m dcnn_tpu.obs.trace)
             ch.send("HELLO", {"rank": self.rank,
@@ -228,7 +235,7 @@ class Membership:
                 sock, _ = self._listen.accept()
             except socket.timeout:
                 continue
-            ch = Channel(sock)
+            ch = Channel(sock, compress=self.compress)
             sock.settimeout(max(deadline - self._clock(), 1.0))
             cmd, meta, _ = ch.recv()
             sock.settimeout(None)
@@ -463,7 +470,8 @@ class ElasticController:
             rank, peers, listen_sock=listen_sock,
             heartbeat_s=config.elastic_heartbeat_s,
             peer_timeout_s=config.elastic_timeout_s,
-            clock=clock, registry=self._reg)
+            clock=clock, registry=self._reg,
+            compress=getattr(config, "elastic_compress", False))
         # the global microbatch grid K is FIXED for the run: batch_size/K
         # rows per microbatch, re-partitioned (never re-gridded) across
         # whatever world survives — this is what keeps grad accumulation
@@ -722,8 +730,13 @@ class ElasticController:
             with tracer.span("elastic.step", track="elastic",
                              parent=self._gen_ctx, rank=self.rank,
                              gen=self.gen, step=gs):
+                # the put above shipped the loader's wire dtype (uint8
+                # pixels for image loaders — 1/4 the H2D bytes); decode
+                # on device per the scale contract (identity for floats)
+                xd = _wire.decode_batch(jnp.asarray(x),
+                                        _wire.wire_scale(self.loader))
                 grad_sum, state_new, loss_sum = gstep(
-                    ts.params, ts.state, jnp.asarray(x), jnp.asarray(y),
+                    ts.params, ts.state, xd, jnp.asarray(y),
                     step_rng, jnp.asarray(lo, jnp.int32))
                 flat = np.asarray(jax.flatten_util.ravel_pytree({
                     "g": grad_sum,

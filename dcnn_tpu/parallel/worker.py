@@ -77,7 +77,7 @@ class StageWorker:
     poll granularity before any coordinator has configured liveness.
     """
 
-    def __init__(self, port: int, compress: bool = False, *,
+    def __init__(self, port: int, compress: "bool | str" = False, *,
                  listen_sock=None, idle_poll_s: float = 60.0,
                  fault_plan: Optional[_faults.FaultPlan] = None,
                  clock=time.monotonic):
@@ -543,5 +543,5 @@ class StageWorker:
                                "layers": self._layers}, raw=blob)
 
 
-def run_worker(port: int, compress: bool = False, **kw) -> None:
+def run_worker(port: int, compress: "bool | str" = False, **kw) -> None:
     StageWorker(port, compress=compress, **kw).serve()

@@ -32,6 +32,8 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from flax import serialization
 
 from ..nn.sequential import Sequential
@@ -93,5 +95,17 @@ def load_checkpoint(path: str, seed: int = 0,
 
     with open(os.path.join(path, _ARRAYS), "rb") as f:
         restored = serialization.from_bytes(template, f.read())
+    # from_bytes leaves are np.frombuffer views into the msgpack blob —
+    # they pin the whole file's bytes alive, and worse: the CPU runtime
+    # zero-copy *aliases* 64-byte-aligned host numpy buffers on
+    # device_put, so when a restored leaf lands in a donating jitted
+    # step (resume / guard rollback) the donated output can reuse host
+    # memory whose lifetime numpy still controls — allocation-dependent
+    # use-after-free (observed: denormal garbage in resumed params).
+    # jnp.array(copy=True) is the one constructor guaranteed to land in
+    # an XLA-owned buffer, never an alias.
+    restored = jax.tree_util.tree_map(
+        lambda x: jnp.array(x, copy=True) if isinstance(x, np.ndarray)
+        else x, restored)
     return (model, restored["params"], restored["state"],
             restored.get("opt_state"), optimizer, manifest.get("metadata", {}))

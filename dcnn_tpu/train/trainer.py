@@ -233,8 +233,14 @@ def evaluate_classification(model, params, state, loss_fn, loader,
         return float(loss_sum) / n, int(correct) / n
     eval_step = eval_step if eval_step is not None else make_eval_step(model, loss_fn)
     total_loss, total_correct, total_n = 0.0, 0, 0
+    # host loaders ship wire-dtype batches (uint8 pixels): decode after
+    # the put, per the loader's scale contract (identity for float input)
+    from ..data.wire import decode_batch, wire_scale
+    scale = wire_scale(loader)
     for x, y in loader:
-        loss, correct = eval_step(params, state, jnp.asarray(x), jnp.asarray(y))
+        loss, correct = eval_step(params, state,
+                                  decode_batch(jnp.asarray(x), scale),
+                                  jnp.asarray(y))
         total_loss += float(loss) * x.shape[0]
         total_correct += int(correct)
         total_n += x.shape[0]
@@ -416,8 +422,13 @@ class Trainer:
         tracer = get_tracer()
         total_loss, total_correct, total_n, batches = 0.0, 0, 0, 0
         t0 = time.perf_counter()
+        # wire-dtype contract: the put above ships the loader's wire
+        # dtype (uint8 pixels); decode to model domain on device, after
+        # the transfer (identity for float batches)
+        from ..data.wire import decode_batch, wire_scale
+        scale = wire_scale(loader)
         for bi, (x, y) in enumerate(loader):
-            x, y = jnp.asarray(x), jnp.asarray(y)
+            x, y = decode_batch(jnp.asarray(x), scale), jnp.asarray(y)
             step_rng = jax.random.fold_in(rng, bi)
             self._global_step += 1
             if self.watchdog is not None:
@@ -538,10 +549,14 @@ class Trainer:
         sample_ndim = len(self.model.input_shape)
         total_loss, total_n = 0.0, 0
         t0 = time.perf_counter()
+        # decode after the put, per the loader's wire contract (identity
+        # for float chunks and for PrefetchLoader's auto-decoded output)
+        from ..data.wire import decode_batch, wire_scale
+        scale = wire_scale(loader)
         for ci, (xs, ys) in enumerate(loader):
             if self.watchdog is not None:
                 self.watchdog.beat()
-            xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+            xs, ys = decode_batch(jnp.asarray(xs), scale), jnp.asarray(ys)
             if xs.ndim != sample_ndim + 2:
                 raise ValueError(
                     f"steps_per_dispatch={self.config.steps_per_dispatch} "
@@ -749,7 +764,8 @@ class Trainer:
                             and not isinstance(train_loader, _DD)):
                         # chunked loader yields [K, B, ...]: profile one batch
                         x, y = x[0], y[0]
-                    x = jnp.asarray(x)
+                    from ..data.wire import decode_batch, wire_scale
+                    x = decode_batch(jnp.asarray(x), wire_scale(train_loader))
                     logits, _ = self.profiler.profile_forward(
                         self.model, ts.params, ts.state, x,
                         training=True, rng=epoch_rng)

@@ -32,6 +32,71 @@ def resolve_cache_root(cache_dir: str = "/tmp/jax_cache") -> str:
             or cache_dir)
 
 
+def _rotate_if_stale(root: str, fingerprint: str) -> None:
+    """Drop a cache root whose entries were minted by a different
+    runtime. A persistent cache entry is a serialized XLA executable:
+    replaying one compiled by another jaxlib (container image bump
+    between sessions) — or torn by a process that died mid-write —
+    crashes at *execution* time with allocator-state-dependent signals,
+    which is far worse than a cold compile. The fingerprint file is the
+    cheap guard for the version half of that risk; a mismatch (or an
+    unreadable root) rotates the directory aside rather than trusting
+    it."""
+    import shutil
+
+    marker = os.path.join(root, ".runtime-fingerprint")
+    try:
+        with open(marker, "r", encoding="utf-8") as f:
+            if f.read().strip() == fingerprint:
+                return
+    except OSError:
+        # no marker yet: fresh root, or a pre-fingerprint cache — keep
+        # its entries and stamp it below (rotation applies only to a
+        # *mismatched* stamp, where staleness is proven)
+        pass
+    if os.path.isdir(root) and os.path.exists(marker):
+        # fingerprint present but wrong: entries are for another runtime
+        try:
+            shutil.rmtree(root)
+        except OSError:
+            return  # shared/busy dir: leave it; jax will still function
+    try:
+        os.makedirs(root, exist_ok=True)
+        tmp = marker + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(fingerprint + "\n")
+        os.replace(tmp, marker)
+    except OSError:
+        pass  # unwritable root: cache writes will no-op too
+
+
+def _sweep_torn_entries(root: str) -> int:
+    """Drop cache entries torn by a killed writer. jax's disk cache
+    writes the ``*-cache`` payload non-atomically and a later ``put``
+    for the same key is a no-op, so a SIGKILL mid-write (a test-runner
+    timeout, an OOM kill) leaves a truncated serialized executable that
+    is then *permanent* — and replaying it crashes at execution time
+    with allocator-dependent signals. A completed put writes the
+    ``*-atime`` sibling after the payload; a payload with no sibling is
+    exactly the torn case, and it is only ever the kill victim's last
+    in-flight entry, so dropping it costs one recompile."""
+    n = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    present = set(names)
+    for name in names:
+        if name.endswith("-cache") \
+                and f"{name[:-len('-cache')]}-atime" not in present:
+            try:
+                os.unlink(os.path.join(root, name))
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
 def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
                          min_compile_secs: float = 0.5) -> str:
     """Point jax's persistent compilation cache at the resolved root and
@@ -39,8 +104,12 @@ def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
     same resolution — one dir to ship between hosts). Idempotent: safe to
     call from any entry point, any number of times."""
     import jax
+    import jaxlib
 
     root = resolve_cache_root(cache_dir)
+    _rotate_if_stale(root, f"jax={jax.__version__} "
+                           f"jaxlib={jaxlib.__version__}")
+    _sweep_torn_entries(root)
     jax.config.update("jax_compilation_cache_dir", root)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)

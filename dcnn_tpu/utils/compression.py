@@ -116,6 +116,43 @@ class Lz4Compressor:
         return self._n.lz4_decompress(data, raw_size)
 
 
+class ShuffleLz4Compressor:
+    """Byte-plane shuffle + LZ4 — the all-native Blosc analog
+    (``native/src/shuffle.cpp`` + ``lz4codec.cpp``), no optional wheel
+    needed. The shuffle exposes the byte-plane correlation of float
+    gradient/activation tensors to LZ4's match finder; LZ4 keeps the
+    host-CPU cost far below zstd, the right trade for pipeline
+    activation/gradient frames where the sender shares a core with the
+    step loop. Payload layout matches :class:`ShuffleZstdCompressor`:
+    ``[1-byte typesize][shuffled stream]``, LZ4 over the whole thing."""
+
+    codec_id = 5
+
+    def __init__(self, typesize: int = 4, level: int = 0):
+        from .. import native as _native
+        if not 1 <= int(typesize) <= 255:
+            raise ValueError(f"typesize must be 1..255 (1-byte payload "
+                             f"header), got {typesize}")
+        if not _native.lz4_available():
+            raise RuntimeError("native lz4 codec unavailable (no toolchain)")
+        if _native.byte_shuffle(b"", 1) is None:
+            raise RuntimeError("native shuffle unavailable (no toolchain)")
+        self._n = _native
+        self.typesize = int(typesize)
+        self.level = int(level)
+        if self.level > 0:
+            _native.lz4_compress(b"", level=self.level)
+
+    def compress(self, data: bytes) -> bytes:
+        t = self.typesize if len(data) % self.typesize == 0 else 1
+        return self._n.lz4_compress(
+            bytes([t]) + self._n.byte_shuffle(data, t), level=self.level)
+
+    def decompress(self, data: bytes, raw_size: int) -> bytes:
+        raw = self._n.lz4_decompress(data, raw_size + 1)
+        return self._n.byte_shuffle(raw[1:], raw[0], inverse=True)
+
+
 class ShuffleZstdCompressor:
     """Blosc-analog codec (reference ``BloscCompressor``,
     ``internal_compressor.hpp:5-15``): byte-plane shuffle (native C++)
@@ -185,7 +222,8 @@ class MetaCompressor:
             # native-backed codecs register lazily (constructing them may
             # trigger the g++ build; MetaCompressor() runs at import time)
             lazy = {Lz4Compressor.codec_id: Lz4Compressor,
-                    ShuffleZstdCompressor.codec_id: ShuffleZstdCompressor}
+                    ShuffleZstdCompressor.codec_id: ShuffleZstdCompressor,
+                    ShuffleLz4Compressor.codec_id: ShuffleLz4Compressor}
             if codec_id in lazy:
                 try:
                     self.register(lazy[codec_id]())
@@ -232,3 +270,52 @@ class MetaCompressor:
         else:
             dtype = np.dtype(descr)
         return np.frombuffer(raw[off:], dtype=dtype).reshape(shape)
+
+
+# name -> constructor for the selectable wire codecs (docs/performance.md
+# codec table). Thunks, not instances: construction may probe the native
+# toolchain / optional wheels, so it must happen at selection time.
+_CODEC_NAMES = {
+    "raw": RawCompressor,
+    "zlib": ZlibCompressor,
+    "zstd": ZstdCompressor,
+    "lz4": Lz4Compressor,
+    "shuffle-lz4": ShuffleLz4Compressor,
+    "shuffle-zstd": ShuffleZstdCompressor,
+}
+
+
+def resolve_codec(spec) -> Optional[Compressor]:
+    """Resolve a wire-codec spec into a :class:`Compressor` (or None).
+
+    The one selection path every framed wire shares
+    (``Channel(compress=...)``, the pipeline coordinator/StageWorker,
+    elastic's mesh):
+
+    - ``False``/``None``/``""`` → ``RawCompressor`` (framed, uncompressed)
+    - ``True`` → the ``DCNN_WIRE_CODEC`` env codec by name, else ``None``
+      (= the MetaCompressor default, zstd when available)
+    - a name from ``{raw, zlib, zstd, lz4, shuffle-lz4, shuffle-zstd}`` →
+      that codec (``RuntimeError`` propagates when its backend is missing
+      — a configured codec must not silently degrade)
+    - a :class:`Compressor` instance → passed through
+
+    Receivers never consult this: decode dispatches on the per-frame
+    codec id, so mixed-configuration fleets interoperate.
+    """
+    if spec is None or spec is False or spec == "":
+        return RawCompressor()
+    if spec is True:
+        import os
+        name = os.environ.get("DCNN_WIRE_CODEC", "").strip().lower()
+        if not name:
+            return None  # MetaCompressor default
+        spec = name
+    if isinstance(spec, str):
+        name = spec.strip().lower()
+        if name not in _CODEC_NAMES:
+            raise ValueError(
+                f"unknown wire codec {spec!r} (choose from "
+                f"{sorted(_CODEC_NAMES)})")
+        return _CODEC_NAMES[name]()
+    return spec

@@ -35,7 +35,7 @@ import jax
 
 import jax.numpy as jnp
 
-from dcnn_tpu.data import MNISTDataLoader
+from dcnn_tpu.data import MNISTDataLoader, decode_host
 from dcnn_tpu.nn import fold_batchnorm, quantize_model
 from dcnn_tpu.ops.losses import softmax_cross_entropy
 from dcnn_tpu.train import load_checkpoint
@@ -110,7 +110,9 @@ def main():
         cal_loader = val
     calib_batches = []
     for xb, _ in cal_loader:
-        calib_batches.append(np.asarray(xb))
+        # loader batches are raw uint8 (wire contract) — decode to the
+        # model domain the quantizer calibrates in
+        calib_batches.append(decode_host(np.asarray(xb), cal_loader.scale))
         if len(calib_batches) >= 2:
             break
     calib = jnp.asarray(np.concatenate(calib_batches))

@@ -44,7 +44,7 @@ import numpy as np
 
 import dcnn_tpu  # noqa: F401  (platform override side effects)
 
-from dcnn_tpu.data import MNISTDataLoader
+from dcnn_tpu.data import MNISTDataLoader, decode_host
 from dcnn_tpu.serve import DynamicBatcher, InferenceEngine, ServeMetrics
 from dcnn_tpu.serve import open_loop as run_open_loop
 from dcnn_tpu.train import load_checkpoint
@@ -74,7 +74,9 @@ def main():
     val.load_data()
     xs, ys = [], []
     for xb, yb in val:
-        xs.append(np.asarray(xb))
+        # loader batches are raw uint8 (wire contract) — the serving
+        # engine's graph is traced for float32 model-domain inputs
+        xs.append(decode_host(np.asarray(xb), val.scale))
         ys.append(np.asarray(yb))
     samples = np.concatenate(xs)
     labels = np.concatenate(ys).argmax(-1)
@@ -91,7 +93,7 @@ def main():
                               data_format=fmt, batch_size=512,
                               shuffle=False, drop_last=False)
         cal.load_data()
-        calib = np.asarray(next(iter(cal))[0])
+        calib = decode_host(np.asarray(next(iter(cal))[0]), cal.scale)
     t0 = time.perf_counter()
     engine = InferenceEngine.from_model(
         model, params, state, int8_calib=calib if int8 else None,

@@ -48,7 +48,11 @@ def test_mnist_csv_loader(tmp_path):
     loader = MNISTDataLoader(str(csv), batch_size=3, shuffle=False)
     x, y = next(iter(loader))
     assert x.shape == (3, 1, 28, 28)
-    assert x.max() <= 1.0 and x.min() >= 0.0
+    # uint8-first wire contract: pixels stay raw uint8 on the host,
+    # decode (x * 1/255) happens on device after the put
+    assert x.dtype == np.uint8
+    assert loader.wire_dtype == np.uint8
+    assert loader.scale == 1.0 / 255.0
     np.testing.assert_array_equal(np.argmax(y, -1), labels)
 
 
@@ -62,6 +66,10 @@ def test_mnist_csv_float_pixels_fallback(tmp_path):
     loader = MNISTDataLoader(str(csv), batch_size=1, shuffle=False, drop_last=False)
     x, y = next(iter(loader))
     assert x.shape == (1, 1, 28, 28)
+    # fractional pixels can't ride the uint8 wire: normalized at load,
+    # float32 wire dtype, identity decode (scale 1.0)
+    assert x.dtype == np.float32
+    assert loader.wire_dtype == np.float32 and loader.scale == 1.0
     np.testing.assert_allclose(x, 0.5 / 255.0, rtol=1e-6)
     assert np.argmax(y) == 7
 
@@ -78,6 +86,10 @@ def test_cifar10_bin_loader(tmp_path):
     loader = CIFAR10DataLoader(str(path), batch_size=7, shuffle=False, drop_last=False)
     x, y = next(iter(loader))
     assert x.shape == (7, 3, 32, 32)
+    assert x.dtype == np.uint8 and loader.wire_dtype == np.uint8
+    # raw record bytes survive untouched (no float round trip)
+    np.testing.assert_array_equal(
+        x[0].ravel(), recs[0][1:])
     np.testing.assert_array_equal(np.argmax(y, -1), labels)
 
 
@@ -134,7 +146,9 @@ def test_tiny_imagenet_loader(tmp_path):
                                    drop_last=False, cache=True)
     x, y = next(iter(train))
     assert x.shape == (6, 3, 64, 64)
-    assert x.dtype == np.float32 and x.max() <= 1.0
+    # uint8 wire: decoded pixels stay raw bytes; decode lives on device
+    assert x.dtype == np.uint8
+    assert train.wire_dtype == np.uint8 and train.scale == 1.0 / 255.0
     assert y.shape == (6, 200)
     # labels 0..1 used (two wnids)
     assert set(np.argmax(y, -1)) == {0, 1}
@@ -346,6 +360,7 @@ def test_download_idx_to_csv_roundtrip(tmp_path):
     loader.load_data()
     x, y = next(iter(loader))
     assert x.shape == (5, 1, 28, 28)
-    np.testing.assert_allclose(
-        x.reshape(5, 28, 28), imgs.astype(np.float32) / 255.0, atol=1e-6)
+    # the uint8 wire makes the round trip exact — not atol-close
+    assert x.dtype == np.uint8
+    np.testing.assert_array_equal(x.reshape(5, 28, 28), imgs)
     np.testing.assert_array_equal(np.argmax(y, axis=1), labels)

@@ -127,7 +127,7 @@ def test_committed_artifacts_hit_committed_accuracy():
     a saved snapshot; here the saved *program* is what evaluates.)"""
     import os
 
-    from dcnn_tpu.data import MNISTDataLoader
+    from dcnn_tpu.data import MNISTDataLoader, decode_host
     from dcnn_tpu.data.digits28 import ensure_digits28_csvs
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -136,9 +136,12 @@ def test_committed_artifacts_hit_committed_accuracy():
     val = MNISTDataLoader(csv, data_format="NCHW", batch_size=512,
                           shuffle=False, drop_last=False)
     val.load_data()
+    # the loader serves raw uint8 (wire contract, docs/performance.md §5);
+    # the committed artifacts were traced for float32, so this consumer
+    # decodes per the contract before feeding them
     xs, ys = [], []
     for xb, yb in val:
-        xs.append(np.asarray(xb))
+        xs.append(decode_host(np.asarray(xb), val.scale))
         ys.append(np.asarray(yb))
     x = jnp.asarray(np.concatenate(xs))
     y = np.concatenate(ys).argmax(-1)

@@ -1,0 +1,341 @@
+"""Bit-parity of the uint8 feed wire across every path, plus wire codecs.
+
+The wire-dtype contract (docs/performance.md §"The wire-dtype contract"):
+image feeds ship raw **uint8** and the consumer decodes
+``x.astype(float32) * float32(1/255)`` AFTER the put. The reference here
+is ``wire.decode_host`` (the numpy multiply); every feed path — serial
+``BaseDataLoader`` iteration, ``serial_shards``, the ``FeedWorkerPool``,
+``PrefetchLoader``'s auto-installed device decode, the streaming shard
+gather — must land on bit-identical float32 pixels, and the wire payload
+must be 4x smaller than the decoded batch (the ISSUE 16 acceptance gate).
+
+The codec half: the byte-shuffle + LZ4 wire codec must round-trip
+bit-exactly through a REAL socketpair ``Channel`` (per-frame codec-id
+dispatch, no receiver configuration) and reject truncated/garbage
+streams instead of decoding nonsense.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dcnn_tpu.data import (
+    ArrayDataLoader, AugmentationBuilder, PrefetchLoader,
+    StreamingDeviceDataset, decode_batch, decode_host, one_hot, wire_scale,
+)
+from dcnn_tpu.data.wire import WIRE_SCALE_U8, decode_fn
+from dcnn_tpu.data.workers import (FeedWorkerPool, LocalSlots,
+                                   serial_shards)
+from dcnn_tpu.parallel.comm import MAGIC, Channel, ChannelClosed, _HEADER
+from dcnn_tpu.utils.compression import (
+    Lz4Compressor, MetaCompressor, RawCompressor, ShuffleLz4Compressor,
+    ZlibCompressor, resolve_codec,
+)
+
+
+def _data(n=192, hw=8, c=3, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n, hw, hw, c), dtype=np.uint8)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def _aug():
+    return (AugmentationBuilder("NHWC").horizontal_flip(p=0.5)
+            .random_crop(2, p=1.0).brightness(0.2, p=0.5).build())
+
+
+def _shuffle_lz4_or_skip():
+    try:
+        return ShuffleLz4Compressor()
+    except RuntimeError as e:
+        pytest.skip(f"native lz4/byte-shuffle unavailable: {e}")
+
+
+# -- the decode contract -----------------------------------------------------
+
+def test_wire_is_4x_smaller_and_decode_bit_identical():
+    """ISSUE 16 acceptance: wire bytes drop >= 4x vs float32 while the
+    decoded batch is bit-identical to the host reference multiply."""
+    x, y = _data()
+    loader = ArrayDataLoader(x, one_hot(y, 10), batch_size=64, shuffle=False)
+    assert loader.wire_dtype == np.uint8
+    assert loader.scale == WIRE_SCALE_U8
+    xb, _ = next(iter(loader))
+    ref = decode_host(xb, loader.scale)
+    assert ref.dtype == np.float32
+    # >= 4x fewer bytes on the wire than the f32 the model consumes
+    assert ref.nbytes >= 4 * xb.nbytes
+    dev = decode_batch(jnp.asarray(xb), wire_scale(loader))
+    np.testing.assert_array_equal(np.asarray(dev), ref)
+
+
+def test_decode_is_the_multiply_not_the_division():
+    """The multiply-by-rounded-reciprocal form is normative: it matches
+    the device decode bit-for-bit, while /255 differs by 1 ulp on some
+    values (double rounding) — the exact drift the contract forbids."""
+    x = np.arange(256, dtype=np.uint8)
+    ref = x.astype(np.float32) * np.float32(1.0 / 255.0)
+    np.testing.assert_array_equal(decode_host(x), ref)
+    np.testing.assert_array_equal(np.asarray(decode_batch(jnp.asarray(x))),
+                                  ref)
+    div = x.astype(np.float32) / np.float32(255.0)
+    assert not np.array_equal(ref, div)  # they really are different series
+
+
+def test_decode_fn_cached_and_identity_on_floats():
+    # one jitted callable per scale (TS06: no per-call closure retrace)
+    assert decode_fn(WIRE_SCALE_U8) is decode_fn(WIRE_SCALE_U8)
+    xf = np.random.default_rng(0).random((4, 3)).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(decode_batch(jnp.asarray(xf))),
+                                  xf)
+    np.testing.assert_array_equal(decode_host(xf), xf)
+    # float loaders declare the identity decode
+    lf = ArrayDataLoader(xf, one_hot(np.zeros(4, np.int64), 2),
+                         batch_size=2, shuffle=False)
+    assert lf.wire_dtype == np.float32 and lf.scale == 1.0
+
+
+# -- bit-parity across the feed paths ----------------------------------------
+
+def test_serial_iter_requantize_matches_manual_convention():
+    """BaseDataLoader.__iter__ on a uint8 loader augments in float32
+    0..255 domain and re-quantizes clip+rint+cast — byte-identical to
+    applying the convention by hand with the same rng stream."""
+    x, y = _data(n=128)
+    aug = _aug()
+    loader = ArrayDataLoader(x, one_hot(y, 10), batch_size=32,
+                             shuffle=False, augmentation=aug, seed=5)
+    got = [xb for xb, _ in loader]
+    rng = loader.epoch_rng()
+    for i, take in enumerate(loader.batch_indices(rng)):
+        xf = aug(x[take].astype(np.float32), rng)
+        np.clip(xf, 0.0, 255.0, out=xf)
+        np.rint(xf, out=xf)
+        want = xf.astype(np.uint8)
+        assert got[i].dtype == np.uint8
+        np.testing.assert_array_equal(got[i], want)
+
+
+def test_pool_and_serial_shards_decode_to_identical_floats():
+    """serial path vs FeedWorkerPool: identical uint8 wire bytes, hence
+    identical decoded float32 — for augmented and plain feeds."""
+    x, y = _data()
+    rng = np.random.default_rng(1)
+    sels = [np.sort(rng.permutation(len(x))[:64]) for _ in range(4)]
+    for aug in (None, _aug()):
+        ser = [(a.copy(), b.copy()) for a, b, _ in
+               serial_shards(x, y, sels, augment=aug, seed=7, epoch=2)]
+        pool = FeedWorkerPool(
+            x, y, 64, num_workers=2, augment=aug, seed=7,
+            backend="thread", poll_s=0.02,
+            slots=LocalSlots(4, 64, x.shape[1:], x.dtype,
+                             y.shape[1:], y.dtype))
+        got = []
+        for ps in pool.shards(sels, epoch=2):
+            got.append((ps.x.copy(), ps.y.copy()))
+            ps.release()
+        pool.close()
+        for (sx, sy), (gx, gy) in zip(ser, got):
+            assert sx.dtype == gx.dtype == np.uint8
+            np.testing.assert_array_equal(sx, gx)
+            np.testing.assert_array_equal(sy, gy)
+            np.testing.assert_array_equal(
+                np.asarray(decode_batch(jnp.asarray(gx))), decode_host(sx))
+
+
+def test_prefetch_auto_decode_bit_identical_to_host_reference():
+    """A uint8-wire inner with no explicit device_transform: the staged
+    put ships uint8 and the yielded x is already the decoded float32 —
+    bit-identical to decoding the serial host batches."""
+    x, y = _data(n=128)
+    inner = ArrayDataLoader(x, one_hot(y, 10), batch_size=32, shuffle=False)
+    want = [decode_host(xb, inner.scale) for xb, _ in inner]
+    pf = PrefetchLoader(inner, depth=2)
+    assert pf.wire_dtype == np.uint8 and pf.scale == WIRE_SCALE_U8
+    got = [(np.asarray(dx), np.asarray(dy)) for dx, dy in pf]
+    assert len(got) == len(want)
+    for w, (gx, _) in zip(want, got):
+        assert gx.dtype == np.float32
+        np.testing.assert_array_equal(gx, w)
+    # an explicit device_transform still wins over the auto decode
+    pf2 = PrefetchLoader(inner, depth=2,
+                         device_transform=lambda a, b: (a, b))
+    gx2, _ = next(iter(pf2))
+    assert np.asarray(gx2).dtype == np.uint8
+
+
+def test_streaming_shard_gather_decodes_to_reference():
+    """The streaming path's shard gather keeps raw uint8 rows; the device
+    decode of a gathered shard equals the host reference decode of the
+    same selection."""
+    x, y = _data(n=256)
+    sds = StreamingDeviceDataset(x, y, 10, batch_size=32, shard_batches=2,
+                                 seed=3)
+    ref = StreamingDeviceDataset(x, y, 10, batch_size=32, shard_batches=2,
+                                 seed=3)
+    sels = list(ref.shard_selections())
+    shards = list(sds.shards())
+    assert len(shards) == len(sels) == sds.num_shards
+    for (sx, sy), sel in zip(shards, sels):
+        assert sx.dtype == np.uint8
+        np.testing.assert_array_equal(sx, x[sel])
+        np.testing.assert_array_equal(sy, y[sel])
+        np.testing.assert_array_equal(
+            np.asarray(decode_batch(jnp.asarray(sx))), decode_host(x[sel]))
+
+
+# -- wire codecs -------------------------------------------------------------
+
+def test_resolve_codec_semantics(monkeypatch):
+    assert isinstance(resolve_codec(False), RawCompressor)
+    assert isinstance(resolve_codec(None), RawCompressor)
+    assert isinstance(resolve_codec(""), RawCompressor)
+    assert isinstance(resolve_codec("zlib"), ZlibCompressor)
+    inst = ZlibCompressor()
+    assert resolve_codec(inst) is inst
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        resolve_codec("snappy")
+    monkeypatch.delenv("DCNN_WIRE_CODEC", raising=False)
+    assert resolve_codec(True) is None  # MetaCompressor default
+    monkeypatch.setenv("DCNN_WIRE_CODEC", "zlib")
+    assert isinstance(resolve_codec(True), ZlibCompressor)
+
+
+def test_elastic_compress_env_knob(monkeypatch):
+    from dcnn_tpu.core.config import TrainingConfig
+    assert TrainingConfig().elastic_compress == ""
+    monkeypatch.setenv("ELASTIC_COMPRESS", "shuffle-lz4")
+    assert TrainingConfig.load_from_env().elastic_compress == "shuffle-lz4"
+
+
+def test_shuffle_lz4_roundtrip_exact_and_compresses():
+    codec = _shuffle_lz4_or_skip()
+    assert codec.codec_id == 5
+    mc = MetaCompressor()
+    # periodic float32 (tied-weights-like): byte-shuffle groups the
+    # exponent bytes and LZ4 matches the repeating period
+    arr = np.tile(np.linspace(0.0, 1e-3, 1024, dtype=np.float32),
+                  16).reshape(64, 256)
+    blob = mc.compress_array(arr, codec=codec)
+    assert len(blob) < arr.nbytes
+    back = mc.decompress_array(blob)
+    assert back.dtype == arr.dtype
+    np.testing.assert_array_equal(back, arr)
+    # odd-length (typesize-indivisible) payloads fall back to typesize 1
+    raw = bytes(range(251))
+    assert mc.decompress(mc.compress(raw, codec=codec)) == raw
+
+
+def _pipe_channels(send_codec):
+    a, b = socket.socketpair()
+    return Channel(a, compress=send_codec), Channel(b)
+
+
+def _send_async(chan, *args, **kw):
+    t = threading.Thread(target=chan.send, args=args, kwargs=kw,
+                         daemon=True)
+    t.start()
+    return t
+
+
+def test_shuffle_lz4_through_real_channel_and_mixed_codecs():
+    """The codec rides a REAL socketpair Channel: sender configured with
+    shuffle-lz4, receiver completely unconfigured — per-frame codec-id
+    dispatch decodes it, and the raw reply on the same pair proves
+    mixed-codec fleets interoperate frame by frame."""
+    _shuffle_lz4_or_skip()
+    tx, rx = _pipe_channels("shuffle-lz4")
+    try:
+        grads = (np.random.default_rng(4)
+                 .standard_normal((32, 257)).astype(np.float32) * 1e-2)
+        t = _send_async(tx, "grads", {"step": 3}, grads)
+        cmd, meta, payload = rx.recv()
+        t.join(10.0)
+        assert cmd == "grads" and meta["step"] == 3
+        assert payload.dtype == np.float32
+        np.testing.assert_array_equal(payload, grads)
+        # reply raw (the receiver's Channel default) — sender decodes it
+        # with zero configuration, dispatching on the frame's codec id
+        pix = np.random.default_rng(5).integers(
+            0, 256, size=(8, 8, 3), dtype=np.uint8)
+        t = _send_async(rx, "pixels", None, pix)
+        cmd2, _, payload2 = tx.recv()
+        t.join(10.0)
+        assert cmd2 == "pixels"
+        np.testing.assert_array_equal(payload2, pix)
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_channel_rejects_truncated_and_garbage_streams():
+    """A malformed wire must raise, never decode nonsense: bad magic,
+    a frame that dies mid-payload, and a framed payload whose compressed
+    bytes are truncated/garbled."""
+    _shuffle_lz4_or_skip()
+    # 1) garbage magic
+    a, b = socket.socketpair()
+    chan = Channel(b)
+    try:
+        a.sendall(_HEADER.pack(0xDEADBEEF, 0, 0, 0))
+        with pytest.raises(ConnectionError, match="bad frame magic"):
+            chan.recv()
+    finally:
+        a.close()
+        chan.close()
+    # 2) truncated frame: header promises bytes that never arrive
+    a, b = socket.socketpair()
+    chan = Channel(b)
+    try:
+        meta = b'{"cmd":"x"}'
+        a.sendall(_HEADER.pack(MAGIC, 1, len(meta), 1000) + meta + b"par")
+        a.close()
+        with pytest.raises(ChannelClosed):
+            chan.recv()
+    finally:
+        chan.close()
+    # 3) well-framed but corrupt compressed payload: the lz4 layer must
+    # reject it (ValueError), not hand back garbage bytes
+    mc = MetaCompressor()
+    blob = mc.compress_array(np.arange(4096, dtype=np.float32),
+                             codec=ShuffleLz4Compressor())
+    hdr = blob[:struct.calcsize("<BQ")]
+    body = blob[struct.calcsize("<BQ"):]
+    for bad in (hdr + body[:len(body) // 2],          # truncated stream
+                hdr + bytes(len(body))):              # zeroed garbage
+        a, b = socket.socketpair()
+        chan = Channel(b)
+        try:
+            meta = b'{"cmd":"x"}'
+            a.sendall(_HEADER.pack(MAGIC, 1, len(meta), len(bad))
+                      + meta + bad)
+            with pytest.raises(ValueError):
+                chan.recv()
+        finally:
+            a.close()
+            chan.close()
+
+
+def test_unknown_codec_id_rejected():
+    mc = MetaCompressor()
+    blob = struct.pack("<BQ", 250, 4) + b"abcd"
+    with pytest.raises(ValueError, match="unknown codec id"):
+        mc.decompress(blob)
+
+
+def test_lz4_plain_codec_roundtrip():
+    try:
+        codec = Lz4Compressor()
+    except RuntimeError as e:
+        pytest.skip(f"native lz4 unavailable: {e}")
+    mc = MetaCompressor()
+    arr = np.tile(np.arange(64, dtype=np.uint8), 512)
+    blob = mc.compress_array(arr, codec=codec)
+    assert len(blob) < arr.nbytes
+    np.testing.assert_array_equal(mc.decompress_array(blob), arr)
