@@ -1668,6 +1668,24 @@ def main() -> None:
         "serve_flops_per_sample": snap.get("serve_flops_per_sample"),
     }
 
+    # goodput block (obs/goodput.py): attribute the capture's whole span
+    # stream to buckets and classify it — the "where did the wall go"
+    # verdict next to the raw numbers. Needs the tracer (BENCH_OBS=1);
+    # absent otherwise, and the regress MetricSpec skips pre-r06
+    # captures instead of lying (skip-not-lie).
+    if obs_on:
+        from dcnn_tpu.obs import get_tracer as _get_tracer
+        from dcnn_tpu.obs.goodput import summarize as _goodput_summarize
+        gp = _goodput_summarize(_get_tracer().events())
+        out["telemetry_essentials"]["goodput"] = {
+            "wall_s": round(gp["wall_s"], 3),
+            "buckets": {b: round(v, 3)
+                        for b, v in gp["buckets"].items()},
+            "unattributed_s": round(gp["unattributed_s"], 3),
+            "goodput_fraction": round(gp["goodput_fraction"], 4),
+            "verdict": gp["verdict"],
+        }
+
     # time-resolved history block: stop the capture-long sampler, take a
     # final pass (the last values always land), persist the JSONL next to
     # the capture, and embed the compact min/mean/max stats the regress
@@ -1692,6 +1710,8 @@ def main() -> None:
             "samples": store.samples,
             "step_s": series_stats(store.range("bench_step_seconds_last")),
             "h2d_gbps": series_stats(store.range("h2d_gbps")),
+            "goodput_fraction": series_stats(
+                store.range("goodput_fraction")),
         }
 
     if obs_on:

@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metric-drift", action="store_true",
                    help="MD01: emitted obs.registry metric names and "
                         "docs/observability.md must agree, both ways")
+    p.add_argument("--span-coverage", action="store_true",
+                   help="GP01: every tracer span recorded in the package "
+                        "must map to a goodput bucket in "
+                        "obs/goodput.SPAN_BUCKETS")
     p.add_argument("--tests", default="tests",
                    help="tests directory for --fault-coverage "
                         "(default: tests)")
@@ -64,18 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _run_lints(args) -> int:
-    """The cross-directory coverage lints (FC01/MD01). The package dir is
+    """The cross-directory coverage lints (FC01/MD01/GP01). The package dir is
     the first positional path."""
     from .core import load_project
-    from .coverage import check_fault_coverage, check_metric_drift
+    from .coverage import (check_fault_coverage, check_metric_drift,
+                           check_span_coverage)
 
     pkg = args.paths[0] if args.paths else "dcnn_tpu"
-    project = load_project([pkg])  # parsed once, shared by both lints
+    project = load_project([pkg])  # parsed once, shared by all the lints
     findings = []
     if args.fault_coverage:
         findings += check_fault_coverage(pkg, args.tests, project=project)
     if args.metric_drift:
         findings += check_metric_drift(pkg, args.doc, project=project)
+    if args.span_coverage:
+        findings += check_span_coverage(pkg, project=project)
     if args.only:
         scope = {s.strip().replace(os.sep, "/")
                  for s in args.only.split(",") if s.strip()}
@@ -101,7 +108,7 @@ def main(argv=None) -> int:
         if not os.path.exists(p):
             print(f"error: no such path {p!r}", file=sys.stderr)
             return 2
-    if args.fault_coverage or args.metric_drift:
+    if args.fault_coverage or args.metric_drift or args.span_coverage:
         return _run_lints(args)
     checks = ([c.strip() for c in args.checks.split(",") if c.strip()]
               if args.checks else None)

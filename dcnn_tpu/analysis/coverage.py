@@ -1,7 +1,9 @@
-"""Repo-contract coverage lints: fault-point arming and metric-name drift.
+"""Repo-contract coverage lints: fault-point arming, metric-name drift,
+and tracer-span → goodput-bucket coverage.
 
-These two lints close gaps the AST checks cannot see because the contract
-spans directories the package analysis never reads (``tests/``, ``docs/``):
+These lints close gaps the AST checks cannot see because the contract
+spans directories the package analysis never reads (``tests/``, ``docs/``)
+or a normative table in another module (``obs/goodput.py``):
 
 - **FC01 fault-unarmed** (``python -m dcnn_tpu.analysis --fault-coverage``):
   every :func:`~dcnn_tpu.resilience.faults.trip` point referenced in
@@ -21,11 +23,16 @@ spans directories the package analysis never reads (``tests/``, ``docs/``):
   docs expand; a dynamically-named instrument that the AST cannot
   resolve must carry a ``# dcnn: metric=<glob>`` declaration on its line
   (globs join the emitted set) or it is itself a finding.
+- **GP01 span-unmapped** (``--span-coverage``): every tracer span name
+  recorded in the package must map to a goodput bucket in
+  ``obs/goodput.SPAN_BUCKETS`` (:func:`check_span_coverage`) — unmapped
+  instrumentation silently becomes ``unattributed`` wall time in every
+  ledger window.
 
-Both lints return ordinary :class:`~dcnn_tpu.analysis.core.Finding`
-objects (inline ``# dcnn: disable=FC01/MD01`` suppression applies) and
-exit nonzero from the CLI on unsuppressed findings, so ``tools/check.sh``
-can chain them.
+All three lints return ordinary :class:`~dcnn_tpu.analysis.core.Finding`
+objects (inline ``# dcnn: disable=FC01/MD01/GP01`` suppression applies)
+and exit nonzero from the CLI on unsuppressed findings, so
+``tools/check.sh`` can chain them.
 """
 
 from __future__ import annotations
@@ -272,5 +279,108 @@ def check_metric_drift(pkg_dir: str, doc_path: str, *,
     for f in out:
         mod = project.get(f.path)
         if mod is not None and mod.is_suppressed("MD01", f.line):
+            f.suppressed_by = "inline"
+    return out
+
+
+# -- GP01: tracer-span → goodput-bucket coverage -------------------------
+
+#: Tracer recording entry points whose first argument is the span name.
+SPAN_TAILS = {"span", "begin", "instant", "record_span"}
+#: The recording machinery itself (name *parameters*, export artifacts) —
+#: excluded like METRIC_INFRA.
+SPAN_INFRA = ("obs/tracer.py",)
+#: Where the normative mapping lives.
+GOODPUT_MODULE = "obs/goodput.py"
+
+
+def collect_span_buckets(project: Dict[str, SourceModule]
+                         ) -> Optional[Dict[str, Optional[str]]]:
+    """AST-extract the ``SPAN_BUCKETS`` dict literal from
+    ``obs/goodput.py`` — parsed, never imported, so the lint runs on a
+    host that can't import the package (same reason the other lints work
+    on trees)."""
+    for path, mod in project.items():
+        if not path.endswith(GOODPUT_MODULE):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            else:
+                continue
+            if (isinstance(target, ast.Name)
+                    and target.id == "SPAN_BUCKETS"
+                    and isinstance(node.value, ast.Dict)):
+                mapping: Dict[str, Optional[str]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and isinstance(v, ast.Constant)):
+                        mapping[k.value] = v.value
+                return mapping
+    return None
+
+
+def check_span_coverage(pkg_dir: str, *,
+                        project: Optional[Dict[str, SourceModule]] = None,
+                        mapping: Optional[Dict[str, Optional[str]]] = None
+                        ) -> List[Finding]:
+    """GP01 span-unmapped (``--span-coverage``): every span name recorded
+    through a tracer entry point (``.span``/``.begin``/``.instant``/
+    ``.record_span``) in the package must map to a goodput bucket in
+    ``obs/goodput.SPAN_BUCKETS`` (``None`` — a structural container — is
+    an explicit decision and passes). Unmapped instrumentation would
+    silently become ``unattributed`` wall time in every ledger window,
+    defeating the 100%-attribution contract. F-string names become globs
+    and match glob-tolerantly against the mapping keys (either side may
+    hold the wildcard); a dynamic name the AST cannot resolve is itself
+    a finding. Only dotted ``family.name`` strings are treated as span
+    names — other APIs' ``.begin("x")`` calls don't trip the lint.
+    Inline ``# dcnn: disable=GP01`` applies."""
+    if project is None:
+        project = load_project([pkg_dir])
+    out: List[Finding] = []
+    if mapping is None:
+        mapping = collect_span_buckets(project)
+        if mapping is None:
+            out.append(Finding(
+                "GP01", GOODPUT_MODULE, 0, "<module>", "SPAN_BUCKETS",
+                "obs/goodput.py SPAN_BUCKETS dict literal not found — "
+                "the span→bucket contract has no source of truth"))
+            return out
+    keys = list(mapping)
+    for path, mod in project.items():
+        if path.endswith(SPAN_INFRA) or "/analysis/" in path:
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_tail(node.func) in SPAN_TAILS
+                    and node.args):
+                continue
+            fn = mod.enclosing_function(node)
+            qn = mod.qualname(fn if fn is not None else mod.tree)
+            pat = _name_pattern(node.args[0])
+            if pat is None:
+                out.append(Finding(
+                    "GP01", path, node.lineno, qn, "<unresolvable>",
+                    f".{_call_tail(node.func)}() with a dynamic span "
+                    f"name the lint cannot resolve — use a literal "
+                    f"family.name (or suppress with a mapping decision)"))
+                continue
+            if "." not in pat:
+                continue  # not a span-name shape: some other .begin() API
+            if any(_matches(pat, k) for k in keys):
+                continue
+            out.append(Finding(
+                "GP01", path, node.lineno, qn, pat,
+                f"span '{pat}' is recorded here but missing from "
+                f"obs/goodput.SPAN_BUCKETS — map it to a bucket (or None "
+                f"for structural spans) so its time can't silently become "
+                f"unattributed"))
+    for f in out:
+        mod = project.get(f.path)
+        if mod is not None and mod.is_suppressed("GP01", f.line):
             f.suppressed_by = "inline"
     return out

@@ -409,6 +409,15 @@ def train_streaming_epoch(step, ts, dataset: StreamingDeviceDataset, rng,
         reg.gauge("feed_wire_epoch_bytes",
                   "total bytes shipped host-to-device, last streaming "
                   "epoch").set(float(fed_bytes))
+        tr = get_tracer()
+        if getattr(tr, "enabled", False):
+            # epoch goodput ledger (obs/goodput.py): attribute this
+            # epoch's wall to buckets from the spans recorded above —
+            # the live "you are feed-bound" signal the ROADMAP's #1
+            # wall lacked (gauges: goodput_fraction & friends)
+            from ..obs.goodput import GoodputLedger
+            GoodputLedger(tracer=tr, registry=reg).snapshot(
+                t0_abs=t_epoch0, publish=True)
     # ONE on-device reduction + ONE readback: per-loss float() readbacks
     # measured ~3 s EACH on the tunnelled backend (13.6 s vs 0.41 s for a
     # 4-shard epoch) and were the r4 "overlap stalls at 0.40" culprit

@@ -342,8 +342,36 @@ class FleetAggregator:
                             if k != "values"}
                         for n, info in last.items()},
             "series": series,
+            "slot_goodput": self._slot_goodput(last),
             "tsdb": self.store.summary(),
         }
+
+    @staticmethod
+    def _slot_goodput(last: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Per-replica + fleet slot-occupancy goodput derived from the
+        ``serve_slot_{occupied,idle,draining}_seconds_total`` counters
+        each replica exposes (serve/metrics.py): occupied / total, the
+        serving twin of the trainer's ``goodput_fraction``. Replicas not
+        exposing the counters (trainers, old builds) are skipped."""
+        per: Dict[str, Dict[str, Any]] = {}
+        tot = {"occupied": 0.0, "idle": 0.0, "draining": 0.0}
+        for name, info in last.items():
+            vals = info.get("values") or {}
+            secs = {s: vals.get(f"serve_slot_{s}_seconds_total")
+                    for s in tot}
+            if any(v is None for v in secs.values()):
+                continue
+            total = sum(secs.values())
+            per[name] = {"seconds": secs,
+                         "goodput": (secs["occupied"] / total)
+                         if total > 0 else None}
+            for s in tot:
+                tot[s] += secs[s]
+        fleet_total = sum(tot.values())
+        return {"replicas": per,
+                "fleet": {"seconds": tot,
+                          "goodput": (tot["occupied"] / fleet_total)
+                          if fleet_total > 0 else None}}
 
     def alerts_doc(self) -> Dict[str, Any]:
         """The ``/alerts`` body: every rule's state doc (firing first),

@@ -160,6 +160,12 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("streaming_img_per_sec", "streaming_img_per_sec",
                tolerance=0.3,
                guard="streaming_timeline.wire_bytes_per_image"),
+    # goodput plane (ISSUE 18): the fraction of the capture's wall the
+    # ledger attributes to compute. Only BENCH_OBS=1 r06+ captures carry
+    # the block — earlier captures are skipped, not lied about.
+    MetricSpec("goodput_fraction",
+               "telemetry_essentials.goodput.goodput_fraction",
+               tolerance=0.25),
 )
 
 DEFAULT_TOLERANCE = 0.2

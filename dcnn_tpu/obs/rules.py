@@ -371,3 +371,33 @@ def rules_check(engine: RuleEngine) -> Callable[[], Optional[str]]:
             return "alerts firing: " + ", ".join(firing)
         return None
     return _check
+
+
+def goodput_alert_rules(*, window_s: float = 120.0, for_s: float = 180.0,
+                        min_goodput: float = 0.25) -> List[AlertRule]:
+    """The shipped goodput alert pack (docs/observability.md "Goodput &
+    bottleneck attribution"). Series come from the
+    :class:`~dcnn_tpu.obs.goodput.GoodputMonitor` poll (classifier 0/1
+    state series) and the tsdb-sampled ``goodput_fraction`` gauge.
+    ``for_s`` over the 0/1 ``min_over_time`` is exactly "feed-bound
+    sustained > N windows" — a single-window blip never pages."""
+    return [
+        AlertRule(name="goodput_feed_bound_sustained",
+                  series="goodput_bottleneck_feed_bound",
+                  op=">=", threshold=1.0, fn="min_over_time",
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="classifier has held feed-bound for the "
+                              "whole window — the host feed is the wall"),
+        AlertRule(name="goodput_compile_bound_sustained",
+                  series="goodput_bottleneck_compile_bound",
+                  op=">=", threshold=1.0, fn="min_over_time",
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="sustained compile-bound windows — likely "
+                              "a retrace storm (check TS06 / AOT cache)"),
+        AlertRule(name="goodput_low_fraction",
+                  series="goodput_fraction",
+                  op="<", threshold=min_goodput, fn="avg_over_time",
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="average goodput below the floor — most "
+                              "wall time is not compute"),
+    ]

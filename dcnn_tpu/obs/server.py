@@ -89,6 +89,10 @@ _HEALTH_FLAGS = (
     "serve_router_decommissions_total",
     "serve_router_decommission_sweeps_total",
     "lease_free_devices",
+    # goodput plane (obs/goodput.py): where the wall time went and what
+    # the classifier currently blames, next to the 200/503 verdict
+    "goodput_fraction", "goodput_bottleneck_state",
+    "goodput_unattributed_seconds",
 )
 
 

@@ -70,7 +70,9 @@ def _with_dispatch_span(jitted, name: str, **attrs):
     wrapper forwards ``lower`` (the HLO-inspection tests use it) and is a
     plain passthrough when tracing is disabled."""
     def step(*args):
-        with get_tracer().span(name, track="pipeline", **attrs):
+        # every caller passes the literal "pipe.compiled.step" (mapped in
+        # obs/goodput.SPAN_BUCKETS); the indirection is invisible to GP01
+        with get_tracer().span(name, track="pipeline", **attrs):  # dcnn: disable=GP01
             return jitted(*args)
 
     step.lower = jitted.lower

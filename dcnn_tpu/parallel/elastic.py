@@ -973,14 +973,19 @@ class ElasticController:
         # ends up following, _join_reconf adopts the leader's instead
         rspan = tracer.begin("elastic.reconfigure", track="elastic",
                              rank=self.rank, gen_from=self.gen)
+        # expected stall: the reshard makes the next steps arbitrarily
+        # slow by design — fence it from the goodput anomaly detector so
+        # a planned recovery never burns a capture (obs/anomaly.py)
+        from ..obs.anomaly import suppress as _anomaly_suppress
         try:
-            while True:
-                try:
-                    with tracer.activate(rspan):
-                        out = self._reconfigure_once(sig, gs)
-                    break
-                except (PeerLostError, _ReconfigureSignal) as again:
-                    sig = again
+            with _anomaly_suppress():
+                while True:
+                    try:
+                        with tracer.activate(rspan):
+                            out = self._reconfigure_once(sig, gs)
+                        break
+                    except (PeerLostError, _ReconfigureSignal) as again:
+                        sig = again
             ts, epoch, step, new_gs = out
             if self._gen_ctx is None or self.rank == self.survivors[0]:
                 # leader (or solo survivor): the generation's steps

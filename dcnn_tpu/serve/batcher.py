@@ -105,6 +105,10 @@ class DynamicBatcher:
         self.queue_capacity = queue_capacity
         self.metrics = metrics if metrics is not None else ServeMetrics(
             clock=clock)
+        # open the slot-goodput clock: the dispatch slot exists (and is
+        # idle) from construction, so occupied/idle/draining seconds sum
+        # to the replica's lifetime (serve/metrics.py record_slot_state)
+        self.metrics.record_slot_state("idle")
         self._clock = clock
         self._q: deque = deque()  # dcnn: guarded_by=_cond
         self._rows = 0  # dcnn: guarded_by=_cond
@@ -315,6 +319,7 @@ class DynamicBatcher:
 
     def _run(self, batch: List[_Request]) -> None:
         tracer = get_tracer()
+        self.metrics.record_slot_state("occupied")
         try:
             x = (batch[0].x if len(batch) == 1
                  else np.concatenate([r.x for r in batch]))
@@ -371,6 +376,9 @@ class DynamicBatcher:
             with self._cond:
                 for r in batch:
                     self._accepted.discard(r.future)
+                closing = self._closing
+            self.metrics.record_slot_state(
+                "draining" if closing else "idle")
 
     def step(self, force: bool = True) -> int:
         """Synchronously dispatch one batch (``start=False`` mode and
@@ -438,6 +446,7 @@ class DynamicBatcher:
         with self._cond:
             self._closing = True
             self._cond.notify_all()
+        self.metrics.record_slot_state("draining")
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
@@ -478,6 +487,7 @@ class DynamicBatcher:
                 self._accepted.discard(r.future)
             self.metrics.record_queue_depth(0)
             self._cond.notify_all()
+        self.metrics.record_slot_state("draining")
         tracer = get_tracer()
         for r in queued:
             try:

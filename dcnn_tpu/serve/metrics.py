@@ -43,6 +43,11 @@ from typing import Callable, Dict, Optional
 
 from ..obs.registry import MetricsRegistry
 
+#: Dispatch-slot goodput states: a replica's dispatcher is either running
+#: a batch (occupied), waiting for work (idle), or refusing new work on
+#: the way down (draining). Time-weighted via ``record_slot_state``.
+SLOT_STATES = ("idle", "occupied", "draining")
+
 
 class ServeMetrics:
     """Rolling serving statistics exported as a plain dict.
@@ -77,6 +82,11 @@ class ServeMetrics:
             "serve_queue_depth", "samples currently queued")
         self._lat_hist = self.registry.histogram(
             "serve_latency_seconds", "request latency (submit to complete)")
+        self._slot_counters = {
+            state: self.registry.counter(
+                f"serve_slot_{state}_seconds_total",
+                f"cumulative seconds the dispatch slot spent {state}")
+            for state in SLOT_STATES}
         # initialize the per-instance state WITHOUT touching the registry
         # instruments: on an injected shared registry they may belong to a
         # live sibling instance, and a counter must never go backwards
@@ -92,6 +102,9 @@ class ServeMetrics:
             self._shed_n = 0
             self._batches_n = 0
             self._depth_n = 0
+            self._slot_state: Optional[str] = None
+            self._slot_t = 0.0
+            self._slot_s = {state: 0.0 for state in SLOT_STATES}
             self._t0 = self._clock()
 
     def reset(self) -> None:
@@ -101,7 +114,8 @@ class ServeMetrics:
         decision here, never an accident of construction)."""
         self._init_local()
         for inst in (self._submitted, self._completed, self._shed,
-                     self._batches, self._queue_depth, self._lat_hist):
+                     self._batches, self._queue_depth, self._lat_hist,
+                     *self._slot_counters.values()):
             inst.reset()
 
     # -- recorders (all O(1), thread-safe) --
@@ -140,6 +154,28 @@ class ServeMetrics:
         self._completed.inc(n)
         self._lat_hist.observe(latency_s)
 
+    def record_slot_state(self, state: str) -> None:
+        """The dispatch slot entered ``state`` (one of
+        :data:`SLOT_STATES`). Time-weighted: the interval since the
+        previous transition is credited to the previous state, locally
+        and on the ``serve_slot_<state>_seconds_total`` counters — the
+        per-replica goodput decomposition ``obs/fleet.py`` aggregates."""
+        if state not in SLOT_STATES:
+            raise ValueError(f"slot state must be one of {SLOT_STATES}, "
+                             f"got {state!r}")
+        now = self._clock()
+        prev: Optional[str] = None
+        dt = 0.0
+        with self._lock:
+            if self._slot_state is not None:
+                prev = self._slot_state
+                dt = max(now - self._slot_t, 0.0)
+                self._slot_s[prev] += dt
+            self._slot_state = state
+            self._slot_t = now
+        if prev is not None and dt > 0:
+            self._slot_counters[prev].inc(dt)
+
     # -- export --
     def snapshot(self) -> Dict[str, Optional[float]]:
         """Point-in-time view (every field read under ONE lock — e.g.
@@ -147,12 +183,19 @@ class ServeMetrics:
         Latency keys are ``None`` until the first completion so a consumer
         can't mistake 'no data' for 'zero ms'."""
         with self._lock:
+            now = self._clock()
             lat = sorted(self._lat_s)
             occ = list(self._occ)
             submitted, completed = self._submitted_n, self._completed_n
             shed, batches = self._shed_n, self._batches_n
             depth = self._depth_n
-            wall_s = max(self._clock() - self._t0, 0.0)
+            wall_s = max(now - self._t0, 0.0)
+            slot = dict(self._slot_s)
+            slot_state = self._slot_state
+            if slot_state is not None:
+                # credit the open interval so the decomposition always
+                # sums to the time since the first transition
+                slot[slot_state] += max(now - self._slot_t, 0.0)
 
         def pct(q: float) -> Optional[float]:
             if not lat:
@@ -163,7 +206,13 @@ class ServeMetrics:
             return lat[i] * 1e3
 
         offered = submitted + shed
+        slot_total = sum(slot.values())
         return {
+            "slot_state": slot_state,
+            "slot_seconds": slot,
+            # None until the first transition: no data is not 100% idle
+            "slot_goodput": (slot["occupied"] / slot_total)
+            if slot_total > 0 else None,
             "requests_submitted": submitted,
             "requests_completed": completed,
             "requests_shed": shed,
@@ -196,12 +245,13 @@ class ServeMetrics:
             "serve_batch_occupancy": s["batch_occupancy"],
             "serve_shed_fraction": s["shed_fraction"],
             "serve_throughput_rps": s["throughput_rps"],
+            "serve_slot_goodput": s["slot_goodput"],
         }
         for name, v in derived.items():
             if v is None:
                 continue  # absent series, not a lying 0.0
             lines.extend(render_scalar(
-                name, "gauge", v))  # dcnn: metric=serve_latency_window_*_ms,serve_batch_occupancy,serve_shed_fraction,serve_throughput_rps
+                name, "gauge", v))  # dcnn: metric=serve_latency_window_*_ms,serve_batch_occupancy,serve_shed_fraction,serve_throughput_rps,serve_slot_goodput
         return "\n".join(lines) + "\n"
 
     def __repr__(self) -> str:

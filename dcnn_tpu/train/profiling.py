@@ -154,31 +154,21 @@ _trace_active = False
 _trace_seq = itertools.count()
 
 
-@contextlib.contextmanager
-def trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
-    """XLA-level trace for xprof/tensorboard (the TPU-native answer to the
-    reference's profiling commands, SURVEY.md §5.1).
-
-    ``log_dir`` is the PARENT: every call captures into its own
-    timestamped subdir (``<log_dir>/<YYYYmmdd-HHMMSS>-<pid>-<seq>``,
-    yielded to the caller), so back-to-back traces never clobber each
-    other's capture — the old single hard-coded dir made the second
-    trace of a process overwrite the first. Nested use raises a clear
-    ``RuntimeError`` up front: ``jax.profiler`` supports one capture per
-    process, and the error it raises mid-capture is cryptic.
-
-    The capture is also recorded as a ``profiler.xprof`` span on the
-    shared tracer (``dcnn_tpu.obs``), so an xprof capture shows up on the
-    span timeline and both can run together.
-    """
+def _try_claim() -> bool:
+    """Test-and-set the one-capture-per-process flag."""
     global _trace_active
     with _trace_lock:
         if _trace_active:
-            raise RuntimeError(
-                "profiling.trace() does not nest: an xprof capture is "
-                "already active in this process (jax.profiler supports one "
-                "trace at a time); finish it before starting another")
+            return False
         _trace_active = True
+        return True
+
+
+@contextlib.contextmanager
+def _owned_capture(log_dir: str):
+    """The capture body; assumes the claim is already held and releases
+    it on exit (including the never-entered error paths)."""
+    global _trace_active
     try:
         path = os.path.join(
             log_dir, f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
@@ -195,3 +185,47 @@ def trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
     finally:
         with _trace_lock:
             _trace_active = False
+
+
+def trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
+    """XLA-level trace for xprof/tensorboard (the TPU-native answer to the
+    reference's profiling commands, SURVEY.md §5.1).
+
+    ``log_dir`` is the PARENT: every call captures into its own
+    timestamped subdir (``<log_dir>/<YYYYmmdd-HHMMSS>-<pid>-<seq>``,
+    yielded to the caller), so back-to-back traces never clobber each
+    other's capture — the old single hard-coded dir made the second
+    trace of a process overwrite the first. Nested use raises a clear
+    ``RuntimeError`` up front: ``jax.profiler`` supports one capture per
+    process, and the error it raises mid-capture is cryptic.
+
+    The capture is also recorded as a ``profiler.xprof`` span on the
+    shared tracer (``dcnn_tpu.obs``), so an xprof capture shows up on the
+    span timeline and both can run together.
+    """
+    if not _try_claim():
+        raise RuntimeError(
+            "profiling.trace() does not nest: an xprof capture is "
+            "already active in this process (jax.profiler supports one "
+            "trace at a time); finish it before starting another")
+    return _owned_capture(log_dir)
+
+
+def try_trace(log_dir: str = "/tmp/dcnn_tpu_trace"):
+    """Non-raising :func:`trace`: returns the capture context manager, or
+    ``None`` when a capture is already active (counted on
+    ``profiler_trace_busy_total``). The anomaly-capture path
+    (``obs/anomaly.py``) uses this so an operator's manual trace always
+    wins the race instead of one side crashing.
+
+    The claim is taken HERE, not at ``__enter__`` — a non-None return
+    means the capture slot is yours, so you must enter (and exit) the
+    returned context manager to release it.
+    """
+    if _try_claim():
+        return _owned_capture(log_dir)
+    from ..obs import get_registry
+    get_registry().counter(
+        "profiler_trace_busy_total",
+        "try_trace() calls that found a capture already active").inc()
+    return None

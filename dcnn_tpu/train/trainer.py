@@ -310,6 +310,7 @@ class Trainer:
         self.watchdog = None  # created per fit() when stall_timeout_s > 0
         self.telemetry = None  # TelemetryServer, per fit() (metrics_port)
         self._tsdb = None      # TsdbSampler, per fit() (rides metrics_port)
+        self._goodput = None   # GoodputMonitor, per fit() (rides metrics_port)
         self._global_step = 0
         self.train_step = make_train_step(model, self.loss_fn, optimizer,
                                           self.config.num_microbatches,
@@ -448,6 +449,7 @@ class Trainer:
                     x = jnp.full_like(x, jnp.nan)
             # the float(loss)/correct_count reads inside the span block on
             # the device result, so step spans tile the epoch wall truthfully
+            t_step = time.perf_counter()
             with tracer.span("train.step", track="train", epoch=epoch,
                              batch=bi):
                 if self.guard is not None:
@@ -465,6 +467,8 @@ class Trainer:
                         ts, x, y, step_rng, self.lr)
                 total_loss += float(loss) * x.shape[0]
                 total_correct += int(correct_count(logits, y))
+            if self._goodput is not None:
+                self._goodput.observe_step(time.perf_counter() - t_step)
             total_n += x.shape[0]
             batches += 1
             if (self.scheduler is not None
@@ -582,12 +586,17 @@ class Trainer:
                 lr_arg = jnp.asarray(lrs, jnp.float32)
             else:
                 lr_arg = self.lr
+            t_chunk = time.perf_counter()
             with get_tracer().span("train.chunk", track="train",
                                    epoch=epoch, chunk=ci,
                                    steps=int(xs.shape[0])):
                 ts, mean_loss = self.multi_step(ts, xs, ys, chunk_rng, lr_arg)
                 n = xs.shape[0] * xs.shape[1]
                 total_loss += float(mean_loss) * n
+            if self._goodput is not None:
+                # per-step anomaly granularity: a chunk is K fused steps
+                self._goodput.observe_step(
+                    (time.perf_counter() - t_chunk) / max(xs.shape[0], 1))
             total_n += n
             if self.config.progress_interval and (ci + 1) % max(
                     self.config.progress_interval // max(xs.shape[0], 1), 1) == 0:
@@ -675,11 +684,41 @@ class Trainer:
                         "DCNN_TSDB_INTERVAL", "1.0"))).start()
                 srv.add_snapshot("tsdb", store.summary)
                 get_flight_recorder().attach_tsdb(store)
-                print(f"telemetry: {srv.url}/metrics /healthz /snapshot",
-                      flush=True)
+                # goodput plane (obs/goodput.py): every sampler pass
+                # attributes the trailing window of tracer spans to
+                # buckets, publishes the gauges, classifies the
+                # bottleneck, and — on an EWMA step-time breach or a
+                # verdict flip — fires exactly one flight bundle +
+                # xprof capture (obs/anomaly.py). /goodput serves the
+                # live doc. No-op attribution when tracing is disabled
+                # (empty span stream ⇒ zero-wall windows).
+                from ..obs.anomaly import AnomalyMonitor
+                from ..obs.goodput import GoodputMonitor
+                from ..obs.rules import (RuleEngine, goodput_alert_rules,
+                                         rules_check)
+                self._goodput = GoodputMonitor(
+                    tracer=tracer, registry=reg, store=store,
+                    window_s=float(os.environ.get(
+                        "DCNN_GOODPUT_WINDOW", "30.0")),
+                    samples_per_step=cfg.batch_size,
+                    anomaly=AnomalyMonitor(
+                        registry=reg,
+                        profile_dir=os.environ.get("DCNN_ANOMALY_XPROF"))
+                ).attach(srv)
+                self._tsdb.add_after_sample(self._goodput.poll)
+                engine = RuleEngine(store, registry=reg)
+                for rule in goodput_alert_rules():
+                    engine.add_alert(rule)
+                self._tsdb.add_after_sample(lambda s: engine.evaluate())
+                srv.add_check("alerts", rules_check(engine))
+                print(f"telemetry: {srv.url}/metrics /healthz /snapshot"
+                      f" /goodput", flush=True)
             return self._fit_loop(ts, train_loader, val_loader, epochs,
                                   start_epoch, rng, best_val, tracer, reg)
         finally:
+            if self._goodput is not None:
+                self._goodput.close()  # end any open anomaly xprof capture
+                self._goodput = None
             if self._tsdb is not None:
                 # detach OUR store only: a later bundle must not dump
                 # this dead run's frozen history as if it were current,

@@ -21,7 +21,9 @@ warm caches keep hitting), serialized executables under ``<root>/aot``.
 
 from __future__ import annotations
 
+import atexit
 import os
+import signal
 
 
 def resolve_cache_root(cache_dir: str = "/tmp/jax_cache") -> str:
@@ -97,6 +99,179 @@ def _sweep_torn_entries(root: str) -> int:
     return n
 
 
+# -- session-integrity protocol (quarantine of crashed writers) ---------
+#
+# The torn-entry sweep above catches a payload with no ``-atime``
+# sibling, but a process that corrupts its own memory (a jaxlib
+# SIGSEGV/SIGABRT) can serialize a *structurally valid* executable whose
+# replay crashes every LATER process at dispatch time — observed live: a
+# single stale ``jit_update-*`` entry minted by a crashing test run made
+# an otherwise-green suite segfault on ~60% of runs until the entry was
+# deleted, and each crashed run can mint more such entries (the
+# infection sustains itself across sessions). No structural check can
+# see this (the bytes decompress fine), so the guard is provenance: an
+# entry only survives if the process that minted it EXITED CLEANLY.
+#
+#   <root>/.committed      names of ``*-cache`` payloads whose minting
+#                          session finished cleanly (atexit / SIGTERM)
+#   <root>/.inflight/<pid> live marker per enabling process — a sweep
+#                          never deletes while another enabler is alive
+#                          (its fresh entries are uncommitted by design)
+#
+# A root with entries but no manifest is grandfathered (same policy as
+# the pre-fingerprint case in ``_rotate_if_stale``): its entries are
+# committed wholesale rather than dropped, so existing warm caches keep
+# hitting; the protocol protects every mint from then on.
+
+_COMMITTED = ".committed"
+_INFLIGHT = ".inflight"
+
+# root -> names of ``*-cache`` payloads present when the session began
+_SESSIONS: "dict[str, set[str]]" = {}
+_HOOKS_INSTALLED = False
+
+
+def _cache_names(root: str) -> "set[str]":
+    try:
+        return {n for n in os.listdir(root) if n.endswith("-cache")}
+    except OSError:
+        return set()
+
+
+def _read_committed(root: str) -> "set[str]":
+    try:
+        with open(os.path.join(root, _COMMITTED), encoding="utf-8") as f:
+            return {ln.strip() for ln in f if ln.strip()}
+    except OSError:
+        return set()
+
+
+def _write_committed(root: str, names: "set[str]") -> None:
+    path = os.path.join(root, _COMMITTED)
+    tmp = path + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("".join(n + "\n" for n in sorted(names)))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # unwritable root: cache writes no-op too
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM: alive, someone else's
+    return True
+
+
+def _other_live_enablers(root: str) -> bool:
+    """True if another live process has this root enabled. Dead markers
+    (crashed or SIGKILLed enablers) are pruned on the way."""
+    d = os.path.join(root, _INFLIGHT)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return False
+    alive = False
+    for n in names:
+        try:
+            pid = int(n)
+        except ValueError:
+            continue
+        if pid == os.getpid():
+            continue
+        if _pid_alive(pid):
+            alive = True
+        else:
+            try:
+                os.unlink(os.path.join(d, n))
+            except OSError:
+                pass
+    return alive
+
+
+def _sweep_uncommitted(root: str) -> int:
+    """Quarantine entries whose minting session never exited cleanly.
+
+    Skipped entirely while another live enabler shares the root (its
+    current mints are legitimately uncommitted); with no manifest at all
+    the present entries are grandfathered-committed instead of dropped."""
+    present = _cache_names(root)
+    if not os.path.exists(os.path.join(root, _COMMITTED)):
+        # grandfather a pre-protocol root (possibly empty: the write
+        # still matters — it arms the sweep for entries minted by a
+        # first session that then crashes)
+        _write_committed(root, present)
+        return 0
+    if not present:
+        return 0
+    if _other_live_enablers(root):
+        return 0
+    committed = _read_committed(root)
+    n = 0
+    for name in present - committed:
+        for victim in (name, f"{name[:-len('-cache')]}-atime"):
+            try:
+                os.unlink(os.path.join(root, victim))
+            except OSError:
+                pass
+        n += 1
+    return n
+
+
+def _finish_sessions() -> None:
+    """Clean-exit hook: commit every entry minted during this session
+    (present now, absent at enable time), prune names whose files are
+    gone, drop the inflight marker."""
+    for root, before in list(_SESSIONS.items()):
+        present = _cache_names(root)
+        _write_committed(root, (_read_committed(root)
+                                | (present - before)) & present)
+        try:
+            os.unlink(os.path.join(root, _INFLIGHT, str(os.getpid())))
+        except OSError:
+            pass
+    _SESSIONS.clear()
+
+
+def _on_sigterm(signum, frame):  # pragma: no cover - exercised via kill
+    # a TERM kill (runner timeout) is an orderly death, not memory
+    # corruption: commit the session so the cache stays warm, then die
+    # with the default disposition so the exit code stays truthful
+    _finish_sessions()
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _register_session(root: str) -> None:
+    global _HOOKS_INSTALLED
+    if root in _SESSIONS:
+        return
+    _SESSIONS[root] = _cache_names(root)
+    try:
+        os.makedirs(os.path.join(root, _INFLIGHT), exist_ok=True)
+        # existence-only marker: content is irrelevant, a torn write is
+        # indistinguishable from a whole one
+        marker = os.path.join(root, _INFLIGHT, str(os.getpid()))
+        with open(marker, "w", encoding="utf-8") as f:  # dcnn: disable=AT01
+            f.write("")
+    except OSError:
+        pass
+    if not _HOOKS_INSTALLED:
+        _HOOKS_INSTALLED = True
+        atexit.register(_finish_sessions)
+        try:
+            # chain only onto the default disposition — never fight a
+            # handler the host application installed
+            if signal.getsignal(signal.SIGTERM) is signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            pass  # non-main thread / exotic platform: atexit still covers
+
+
 def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
                          min_compile_secs: float = 0.5) -> str:
     """Point jax's persistent compilation cache at the resolved root and
@@ -109,7 +284,16 @@ def enable_compile_cache(cache_dir: str = "/tmp/jax_cache",
     root = resolve_cache_root(cache_dir)
     _rotate_if_stale(root, f"jax={jax.__version__} "
                            f"jaxlib={jaxlib.__version__}")
-    _sweep_torn_entries(root)
+    swept = _sweep_torn_entries(root) + _sweep_uncommitted(root)
+    _register_session(root)
+    try:
+        from ..obs import get_registry
+        get_registry().counter(
+            "compile_cache_quarantined_total",
+            "cache entries dropped as torn or minted by a session that "
+            "never exited cleanly").inc(swept)
+    except Exception:
+        pass  # cache setup must never depend on the obs plane
     jax.config.update("jax_compilation_cache_dir", root)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)

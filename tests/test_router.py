@@ -766,8 +766,14 @@ def test_router_over_tcp_replicas_kill_and_failover():
             except (ReplicaDeadError, NoReplicasError) as e:
                 exc = e  # typed — acceptable for in-flight rows
             assert f.done() and (exc is None or f.exception() is exc)
+        # death lands in the router only at a sweep: the reader thread
+        # marks the replica dead on ChannelClosed, and on a loaded host
+        # a single sweep can race it — keep sweeping within the deadline
         deadline = time.monotonic() + 10
-        while router.outstanding() and time.monotonic() < deadline:
+        while ((router.outstanding()
+                or router.replica_stats()["tcp0"]["state"] != "dead")
+               and time.monotonic() < deadline):
+            router.check_replicas()
             time.sleep(0.005)
         assert router.outstanding() == 0
         assert router.replica_stats()["tcp0"]["state"] == "dead"

@@ -209,7 +209,10 @@ def test_fixed_memory_independent_of_run_length():
     reg.counter("c_total")
     reg.gauge("g")
     store = TimeSeriesStore(retention=16, coarse_retention=4, clock=fc)
-    sampler = TsdbSampler(store, registry=reg, clock=fc)
+    # tick_clock too: with real perf_counter a slow pass on a loaded host
+    # lands tsdb_sample_seconds in a NEW (lazily-exported) bucket mid-run,
+    # which is one extra series — and this test counts retained points
+    sampler = TsdbSampler(store, registry=reg, clock=fc, tick_clock=fc)
     reg.counter("c_total").inc()
 
     def run(n):

@@ -32,9 +32,10 @@
 #      obs/rules.py edge state + obs/fleet.py poll thread -> CC01
 #      guarded_by) are covered with zero baseline entries.
 #   3. coverage lints (full runs only — they span tests/ and docs/):
-#      --fault-coverage (every FaultPlan trip point armed by a test) and
+#      --fault-coverage (every FaultPlan trip point armed by a test),
 #      --metric-drift (obs.registry emissions <-> docs/observability.md,
-#      both directions).
+#      both directions), and --span-coverage (every recorded tracer span
+#      maps to a goodput bucket in obs/goodput.SPAN_BUCKETS).
 #   4. benchmarks/compare.py --self-test — the bench regression gate's
 #      own fixture run (planted 25% drop must flag; clean history must
 #      pass).
@@ -100,8 +101,8 @@ fi
 if [[ "$changed_only" == 1 ]]; then
   echo "== [3/4] coverage lints — skipped under --changed-only =="
 else
-  echo "== [3/4] fault-coverage + metric-drift lints =="
-  if ! python -m dcnn_tpu.analysis dcnn_tpu --fault-coverage --metric-drift; then
+  echo "== [3/4] fault-coverage + metric-drift + span-coverage lints =="
+  if ! python -m dcnn_tpu.analysis dcnn_tpu --fault-coverage --metric-drift --span-coverage; then
     fail=1
   fi
 fi
