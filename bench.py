@@ -1126,6 +1126,7 @@ def faults_section():
         "restore_verified_s": round(min(restore_s), 4),
         "elastic": elastic_subsection(),
         "pipeline": pipeline_subsection(),
+        "gray": gray_subsection(),
     }
 
 
@@ -1306,6 +1307,243 @@ def pipeline_subsection():
         "replay_overhead_x": round(recovery_batch / max(clean, 1e-9), 2),
         "stages_after": co.num_stages,
         "generation": co.generation,
+    }
+
+
+def gray_subsection():
+    """The measured cost of surviving a fail-SLOW host (gray failure,
+    docs/reliability.md §11): a 3-peer loopback elastic fleet with
+    ``slow_detect`` on and one peer running 10x slow via
+    ``FaultPlan.slow`` — reporting how long the leader's detector took to
+    convict (detection_s) and the eviction/reconfiguration wall — plus
+    the hedged-serving probe: a 2-replica router with one stalled
+    replica, client-measured p99 with hedging off vs on (the
+    ``hedge_p99_ratio`` the regression gate reads) and the probation →
+    rejoin round-trip."""
+    out = {}
+    out.update(_gray_elastic_probe())
+    out.update(_gray_hedge_probe())
+    return out
+
+
+def _gray_elastic_probe():
+    import tempfile
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel import comm
+    from dcnn_tpu.parallel.elastic import ElasticController, PeerSpec
+    from dcnn_tpu.resilience import FaultPlan
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 32)).astype(np.float32)
+    y = one_hot(rng.integers(0, 8, 96), 8)
+
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(3)]
+    peers = [PeerSpec(i, "127.0.0.1", s.getsockname()[1])
+             for i, s in enumerate(socks)]
+    ctls, results = {}, {}
+    # rank 2 (never the leader) stalls 50 ms per step INSIDE the measured
+    # local-compute wall — alive, beating, and dragging the fleet. An
+    # absolute stall (not factor=) so the outlier ratio stays ~10x even
+    # while everyone's EWMA is still decaying off the first-step compile
+    # spike; small batches give the detector enough steps to convict.
+    victim_plan = FaultPlan().slow("elastic.slow_peer", delay_s=0.05)
+
+    with tempfile.TemporaryDirectory() as d:
+        def runner(i):
+            model = (SequentialBuilder("bench_gray").input((32,))
+                     .dense(64).activation("relu").dense(8).build())
+            cfg = TrainingConfig(
+                epochs=8, learning_rate=0.05, seed=3, snapshot_dir=None,
+                elastic=True, elastic_microbatches=6,
+                elastic_timeout_s=20.0, elastic_heartbeat_s=0.0,
+                elastic_ckpt_steps=2, checkpoint_dir=d,
+                slow_detect=True, slow_dwell_s=0.2, slow_min_samples=2)
+            ctl = ElasticController(
+                model, SGD(0.05), "softmax_crossentropy",
+                ArrayDataLoader(x, y, batch_size=12, seed=7),
+                config=cfg, rank=i, peers=peers, listen_sock=socks[i],
+                fault_plan=victim_plan if i == 2 else None)
+            ctls[i] = ctl
+            try:
+                results[i] = ctl.fit(epochs=8)
+            except BaseException as e:  # the victim's eviction surfaces here
+                results[i] = repr(e)
+
+        threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+                   for i in range(3)]
+        t0 = _t.perf_counter()
+        for t in threads:
+            t.start()
+        # detection = fleet start -> the leader's first conviction
+        # (includes warmup/compile; the regress spec's atol absorbs that)
+        t_detect = None
+        deadline = _t.perf_counter() + 120
+        while _t.perf_counter() < deadline:
+            ctl = ctls.get(0)
+            if ctl is not None and ctl.stats["stragglers_evicted"] > 0:
+                t_detect = _t.perf_counter() - t0
+                break
+            if not any(t.is_alive() for t in threads):
+                break
+            _t.sleep(0.01)
+        for t in threads[:2]:  # the evicted victim's thread may linger
+            t.join(timeout=120)
+        if any(t.is_alive() for t in threads[:2]):
+            return {"error": "gray elastic fleet hung", "peers": 3}
+
+    stats = ctls[0].stats
+    return {
+        "peers": 3,
+        "stragglers_evicted": stats["stragglers_evicted"],
+        "detection_s": round(t_detect, 4) if t_detect is not None else None,
+        "evict_wall_s": round(max(stats["reconfigure_s"] or [0.0]), 4),
+        "world_after": ctls[0].world,
+    }
+
+
+def _gray_hedge_probe():
+    import threading as _threading
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from dcnn_tpu.nn import SequentialBuilder
+    from dcnn_tpu.optim import Adam
+    from dcnn_tpu.resilience import FaultPlan
+    from dcnn_tpu.resilience.slowness import SlownessConfig
+    from dcnn_tpu.serve import (
+        InferenceEngine, LocalReplica, Router, RouterMetrics)
+    from dcnn_tpu.train.trainer import create_train_state
+
+    model = (SequentialBuilder("bench_hedge").input((16,))
+             .dense(32).activation("relu").dense(4).build())
+    ts = create_train_state(model, Adam(1e-3), jax.random.PRNGKey(5))
+    engines = [InferenceEngine.from_model(model, ts.params, ts.state,
+                                          max_batch=8,
+                                          name=f"hedge-probe-{i}")
+               for i in range(3)]
+    x = np.random.default_rng(9).normal(size=(1, 16)).astype(np.float32)
+    slow_plan = FaultPlan().slow("serve.slow_replica", delay_s=0.1)
+
+    def burst_p99(router, bursts=25, width=8, warmup=0):
+        """Client-measured p99 over bursts of concurrent requests (the
+        router's own p99 window spans phases, so it can't be the
+        per-phase measurement — it IS the hedge-delay feed, though).
+        ``warmup`` bursts run first with their walls discarded: the
+        hedge delay needs ~20 completions of in-router p99 before it
+        arms, so cold-start walls would measure the warm-up window,
+        not the steady-state hedging benefit."""
+        walls = []
+        recording = False
+
+        def one():
+            t0 = _t.perf_counter()
+            fut = router.submit(x)
+            fut.result(timeout=60)
+            if recording:
+                walls.append(_t.perf_counter() - t0)
+
+        for burst in range(warmup + bursts):
+            recording = burst >= warmup
+            ths = [_threading.Thread(target=one, daemon=True)
+                   for _ in range(width)]
+            for th in ths:
+                th.start()
+            while any(th.is_alive() for th in ths):
+                router.check_replicas()  # pumps hedges + probation
+                _t.sleep(0.002)
+        walls.sort()
+        return walls[min(int(0.99 * (len(walls) - 1) + 0.5),
+                         len(walls) - 1)] * 1e3
+
+    def mk_replicas(with_plan):
+        return [LocalReplica(engines[0], name="hedge-r0", queue_capacity=64,
+                             max_wait_ms=0.5,
+                             fault_plan=slow_plan if with_plan else None),
+                LocalReplica(engines[1], name="hedge-r1", queue_capacity=64,
+                             max_wait_ms=0.5)]
+
+    def run_phase(with_plan, **router_kw):
+        reps = mk_replicas(with_plan)
+        m = RouterMetrics()
+        router = Router(reps, metrics=m, **router_kw)
+        try:
+            return burst_p99(router, warmup=3), m
+        finally:
+            router.shutdown(drain=False)
+            for r in reps:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+
+    p99_healthy, _ = run_phase(False, hedge=False, slow_detect=False)
+    p99_no_hedge, _ = run_phase(True, hedge=False, slow_detect=False)
+    # mult 0.1 over the polluted in-router p99 (~the stall itself) keeps
+    # the hedge delay well under the stall, so a stuck request re-issues
+    # long before the slow replica would have answered
+    p99_hedge, m = run_phase(True, hedge=True, hedge_multiplier=0.1,
+                             hedge_min_s=0.02, slow_detect=False)
+    snap = m.registry.snapshot()
+    hedges = int(snap.get("serve_router_hedges_total", 0))
+
+    # probation round-trip: detector on, no hedging — the slow replica
+    # must be demoted, then rejoin once the fault clears. Three replicas,
+    # not two: with exactly two scored components the fleet median is the
+    # mean of both walls, so the MAD/ratio outlier test can never fire
+    reps = mk_replicas(True) + [
+        LocalReplica(engines[2], name="hedge-r2", queue_capacity=64,
+                     max_wait_ms=0.5)]
+    m2 = RouterMetrics()
+    router = Router(reps, metrics=m2, hedge=False, slow_detect=True,
+                    slow_config=SlownessConfig(min_peers=2, dwell_s=0.1,
+                                               min_samples=2),
+                    probation_cooldown_s=0.2)
+    probation = rejoined = False
+    try:
+        deadline = _t.perf_counter() + 30
+        while _t.perf_counter() < deadline and not probation:
+            burst_p99(router, bursts=2)
+            probation = any(st["probation"]
+                            for st in router.replica_stats().values())
+        if probation:
+            slow_plan.unslow("serve.slow_replica")
+            deadline = _t.perf_counter() + 30
+            while _t.perf_counter() < deadline and not rejoined:
+                burst_p99(router, bursts=1)
+                rejoined = not any(st["probation"]
+                                   for st in router.replica_stats().values())
+    finally:
+        router.shutdown(drain=False)
+        for r in reps:
+            try:
+                r.close()
+            except Exception:
+                pass
+
+    total = sum(int(v) for k, v in snap.items()
+                if k.startswith("serve_router_completed_")) or None
+    return {
+        "hedge_replicas": 2,
+        "p99_healthy_ms": round(p99_healthy, 2),
+        "p99_no_hedge_ms": round(p99_no_hedge, 2),
+        "p99_with_hedge_ms": round(p99_hedge, 2),
+        "hedge_p99_ratio": round(p99_hedge / max(p99_no_hedge, 1e-9), 4),
+        "hedges": hedges,
+        "hedge_wins": int(snap.get("serve_router_hedge_wins_total", 0)),
+        "hedge_rate": (round(hedges / total, 4)
+                       if total else None),
+        "probation_entered": probation,
+        "probation_rejoined": rejoined,
     }
 
 

@@ -11,7 +11,9 @@ or a normative table in another module (``obs/goodput.py``):
   fault hook nobody arms is a recovery path nobody has ever executed, and
   it ships silently. Detection is textual on the test side (the point
   name appearing in any test file), AST-based on the production side
-  (string-literal first argument of a ``trip``/``_trip`` call).
+  (string-literal first argument of a ``trip``/``_trip`` call, or of
+  their delay-injection twins ``slowdown``/``_slowdown``/``_slow_sleep``
+  — ``FaultPlan.slow`` points are recovery paths too).
 - **MD01 metric-drift** (``--metric-drift``): every Counter/Gauge/
   Histogram name emitted through ``obs.registry``-style calls
   (``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)``) must appear
@@ -46,7 +48,11 @@ from typing import Dict, List, Optional, Set, Tuple
 from .callgraph import call_name as _call_tail
 from .core import Finding, SourceModule, load_project
 
-TRIP_TAILS = {"trip", "_trip"}
+TRIP_TAILS = {"trip", "_trip",
+              # the delay-injection twins (FaultPlan.slow): a slowdown
+              # hook nobody arms is a gray-failure path nobody has ever
+              # executed — exactly the FC01 contract
+              "slowdown", "_slowdown", "_slow_sleep"}
 # registry get-or-create calls plus the exposition-side derived-gauge
 # renderer (the windowed percentiles ride render_scalar, not the registry)
 METRIC_TAILS = {"counter", "gauge", "histogram", "render_scalar"}

@@ -107,6 +107,19 @@ class TrainingConfig:
                                       # "shuffle-zstd", "zlib"). Per-frame
                                       # codec ids keep mixed fleets interop
 
+    # -- gray-failure (fail-slow) detection (resilience/slowness.py;
+    #    docs/reliability.md §11). slow_detect gates the training-side
+    #    mitigations (elastic straggler eviction, feed-worker recycle);
+    #    the thresholds seed the shared SlownessConfig, with DCNN_SLOW_*
+    #    env overrides layered on top by SlownessConfig.from_env --
+    slow_detect: bool = False         # convict-and-mitigate on sustained
+                                      # relative slowness (off = observe
+                                      # nothing; fail-stop paths unchanged)
+    slow_dwell_s: float = 1.0         # sustained outlier-hood before convict
+    slow_ratio: float = 2.0           # conviction floor: EWMA > ratio*median
+    slow_mad_k: float = 4.0           # MAD multiplier of the outlier test
+    slow_min_samples: int = 3         # samples before a component is scored
+
     # -- AOT executable cache (dcnn_tpu/aot; docs/performance.md) --
     aot_cache_dir: Optional[str] = None  # cache ROOT: warm-start the
                                       # train/multi step from persisted
@@ -176,6 +189,12 @@ class TrainingConfig:
                                       base.elastic_min_world),
             elastic_compress=get_env("ELASTIC_COMPRESS",
                                      base.elastic_compress),
+            slow_detect=get_env("DCNN_SLOW_DETECT", base.slow_detect),
+            slow_dwell_s=get_env("DCNN_SLOW_DWELL_S", base.slow_dwell_s),
+            slow_ratio=get_env("DCNN_SLOW_RATIO", base.slow_ratio),
+            slow_mad_k=get_env("DCNN_SLOW_MAD_K", base.slow_mad_k),
+            slow_min_samples=get_env("DCNN_SLOW_MIN_SAMPLES",
+                                     base.slow_min_samples),
             aot_cache_dir=get_env("AOT_CACHE",
                                   base.aot_cache_dir or "") or None,
             metrics_port=get_env("METRICS_PORT", base.metrics_port),

@@ -76,6 +76,8 @@ from .. import native
 from ..obs import get_registry, get_tracer
 from ..resilience import faults as _faults
 from ..resilience.retry import retry_call
+from ..resilience.slowness import SlownessConfig, SlownessDetector
+from ..utils.env import get_env
 
 __all__ = [
     "FeedWorkerPool", "PreparedShard", "ShmSlots", "LocalSlots",
@@ -451,17 +453,25 @@ class _SharedArray:
 # ---------------------------------------------------------------------------
 
 def _worker_loop(wid: int, task_get, result_put, x, y, slots, augment,
-                 seed: int) -> None:
+                 seed: int, retired=None) -> None:
     """Take ``(epoch, shard, slot, sel)`` tasks until the ``None``
     sentinel. The ``feed.prepare`` trip point sits between the claim
     report and the work: an armed :class:`InjectedCrash` there simulates a
     worker lost mid-shard (no error report — the parent must notice via
-    liveness), any other armed exception exercises the error-report path."""
+    liveness), any other armed exception exercises the error-report path.
+    The ``feed.slow_worker`` slowdown point (``FaultPlan.slow``) stretches
+    the prep wall the parent's gray-failure recycler judges. ``retired``
+    (thread backend) is the recycle flag: a convicted worker refuses its
+    next claim and dies — the parent produces the shard inline, exactly
+    the worker-death fallback path."""
     while True:
         task = task_get()
         if task is None:
             return
         epoch, idx, slot_id, sel = task
+        if retired is not None and retired():
+            result_put(("retired", wid, epoch, idx))
+            return
         result_put(("start", wid, epoch, idx))
         try:
             _faults.trip("feed.prepare", worker=wid, shard=idx)
@@ -473,6 +483,16 @@ def _worker_loop(wid: int, task_get, result_put, x, y, slots, augment,
             _, _, t = prepare_shard(x, y, sel, augment=augment, rng=rng,
                                     out_x=out_x, out_y=out_y)
             del out_x, out_y
+            extra = _faults.slowdown("feed.slow_worker", t["prep_s"],
+                                     worker=wid, shard=idx)
+            if extra > 0.0:
+                # gray-failure injection: sleep INSIDE the dispatch and
+                # fold the stretch into the reported walls, so the parent
+                # sees a genuinely slow worker, not a lying fast one
+                time.sleep(extra)
+                t["pack_t1"] += extra
+                t["pack_s"] += extra
+                t["prep_s"] += extra
             t["worker"] = wid
             result_put(("done", wid, epoch, idx, t))
         except _faults.InjectedCrash:
@@ -608,6 +628,15 @@ class FeedWorkerPool:
       stall_timeout_s: with no worker message for this long and work
         outstanding, unclaimed shards are rescued inline (covers the
         narrow task-lost-with-its-worker window).
+      slow_detect: enable the gray-failure recycler (default: the
+        ``DCNN_SLOW_DETECT`` env, off). Per-worker prep walls feed a
+        :class:`~dcnn_tpu.resilience.slowness.SlownessDetector`; a
+        *convicted* worker (sustained outlier vs its peers — a fleet-wide
+        slowdown convicts nobody) is retired through the worker-death
+        fallback and counted on ``feed_worker_recycled_total``.
+        Bit-identity is untouched: shard RNG never involves the worker id.
+      slow_config: detector knobs (default ``min_peers=2`` + the
+        ``DCNN_SLOW_*`` env overrides).
     """
 
     def __init__(self, x: np.ndarray, y: np.ndarray, max_rows: int, *,
@@ -615,6 +644,8 @@ class FeedWorkerPool:
                  num_slots: Optional[int] = None, backend: str = "process",
                  mp_context: Optional[str] = None, slots=None,
                  poll_s: float = 0.1, stall_timeout_s: float = 120.0,
+                 slow_detect: Optional[bool] = None,
+                 slow_config: Optional[SlownessConfig] = None,
                  registry=None, tracer=None):
         if num_workers < 0:
             raise ValueError(f"num_workers must be >= 0, got {num_workers}")
@@ -643,12 +674,23 @@ class FeedWorkerPool:
         self._c_fail = reg.counter("feed_worker_failures_total",
                                    "feed worker errors/deaths recovered "
                                    "by inline fallback")
+        self._c_recycled = reg.counter(
+            "feed_worker_recycled_total",
+            "slow (gray-failing) feed workers recycled through the "
+            "worker-death fallback")
         self._g_depth = reg.gauge("feed_queue_depth",
                                   "feed shards in flight (leased slots)")
         self._g_busy = reg.gauge("feed_workers_busy",
                                  "feed workers currently preparing a shard")
         self._g_free = reg.gauge("feed_slots_free",
                                  "free feed ring-buffer slots")
+
+        self.slow_detect = (get_env("DCNN_SLOW_DETECT", False)
+                            if slow_detect is None else bool(slow_detect))
+        self._slowness = SlownessDetector(SlownessConfig.from_env(
+            slow_config if slow_config is not None
+            else SlownessConfig(min_peers=2)))
+        self._retired: set = set()
 
         self._closed = False
         self._active = False
@@ -771,13 +813,43 @@ class FeedWorkerPool:
     def _thread_worker_main(self, wid: int) -> None:
         try:
             _worker_loop(wid, self._task_q.get, self._result_q.put,
-                         self.x, self.y, self.slots, self.augment, self.seed)
+                         self.x, self.y, self.slots, self.augment, self.seed,
+                         retired=lambda: wid in self._retired)
         except _faults.InjectedCrash:
             return  # simulated hard death: exit silently, liveness notices
 
     def _release_slot(self, sid: int) -> None:
         self._free.put(sid)
         self._g_free.set(self._free.qsize())
+
+    def _note_worker_wall(self, wid, prep_s: float) -> None:
+        """Gray-failure recycler: score this worker's prep wall against
+        its peers; a *convicted* worker (sustained relative outlier — a
+        fleet-wide slowdown convicts nobody) is retired through the
+        worker-death fallback. Output bytes are untouched: shard RNG and
+        ordering never involve the worker id."""
+        if not isinstance(wid, int) or wid in self._retired:
+            return  # "inline" rescues are the parent, not a worker; a
+            # retired worker's straggling report must not re-enter the
+            # score forgotten at its conviction
+        self._slowness.observe(f"w{wid}", prep_s)
+        for tr in self._slowness.evaluate():
+            if tr["to"] == "convicted":
+                self._recycle_worker(int(str(tr["component"])[1:]))
+
+    def _recycle_worker(self, wid: int) -> None:
+        h = next((h for h in self._workers if h.wid == wid), None)
+        if h is None or h.reported_dead or wid in self._retired:
+            return
+        if self.alive_workers() <= 1:
+            return  # never retire the last producer
+        self._retired.add(wid)
+        self._slowness.forget(f"w{wid}")
+        self._c_recycled.inc()
+        # process backend: hard kill now (death fallback rescues its
+        # in-flight shard); thread backend: the retired() flag makes the
+        # worker refuse its next claim and exit
+        h.terminate()
 
     def _emit_spans(self, idx: int, t: dict) -> None:
         tr = self._tracer if self._tracer is not None else get_tracer()
@@ -909,7 +981,7 @@ class FeedWorkerPool:
             self._busy.add(wid)
             self._g_busy.set(len(self._busy))
             return True
-        # done/error both end the worker's claim
+        # done/error/retired all end the worker's claim
         self._busy.discard(wid)
         self._g_busy.set(len(self._busy))
         sid = self._poisoned.pop((msg_epoch, idx), None)
@@ -924,17 +996,19 @@ class FeedWorkerPool:
         info = inflight.pop(idx)
         if kind == "done":
             info["timings"] = msg[4]
+            if self.slow_detect:
+                self._note_worker_wall(wid, msg[4].get("prep_s", 0.0))
             if discard:
                 self._release_slot(info["slot"])
             else:
                 ready[idx] = info
         elif discard:
-            # errored shard during abandoned-epoch teardown: nobody will
-            # consume it — just recycle the slot, don't re-produce data
-            # that would immediately be dropped
+            # errored/refused shard during abandoned-epoch teardown: nobody
+            # will consume it — just recycle the slot, don't re-produce
+            # data that would immediately be dropped
             self._c_fail.inc()
             self._release_slot(info["slot"])
-        else:  # "error": worker survives, shard is produced inline
+        else:  # "error"/"retired": the shard is produced inline
             self._c_fail.inc()
             res = self._produce_inline(epoch, idx, info["sel"], info["slot"])
             info["timings"] = res["timings"]

@@ -166,6 +166,23 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("goodput_fraction",
                "telemetry_essentials.goodput.goodput_fraction",
                tolerance=0.25),
+    # gray-failure probes (BENCH_FAULTS=1 `resilience.gray` block,
+    # ISSUE 19): detection + eviction walls for the stalled elastic peer
+    # (50 ms absolute stall per step, ~10x its healthy compute wall)
+    # are loopback sub-second numbers with scheduler noise (atol slack,
+    # like the pipeline kill probe above); hedged-serving p99 is gated as
+    # the with-hedge/without-hedge ratio so machine speed divides out.
+    # Guards pin the probe's topology knobs — pre-r19 captures lack the
+    # block and are skipped, not lied about.
+    MetricSpec("gray.detection_s", "resilience.gray.detection_s",
+               higher_is_better=False, tolerance=1.0, atol=1.0,
+               guard="resilience.gray.peers"),
+    MetricSpec("gray.evict_wall_s", "resilience.gray.evict_wall_s",
+               higher_is_better=False, tolerance=1.0, atol=1.0,
+               guard="resilience.gray.peers"),
+    MetricSpec("gray.hedge_p99_ratio", "resilience.gray.hedge_p99_ratio",
+               higher_is_better=False, tolerance=0.5, atol=0.5,
+               guard="resilience.gray.hedge_replicas"),
 )
 
 DEFAULT_TOLERANCE = 0.2

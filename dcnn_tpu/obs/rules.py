@@ -401,3 +401,48 @@ def goodput_alert_rules(*, window_s: float = 120.0, for_s: float = 180.0,
                   description="average goodput below the floor — most "
                               "wall time is not compute"),
     ]
+
+
+def gray_failure_alert_rules(*, window_s: float = 120.0,
+                             for_s: float = 60.0,
+                             max_imbalance: float = 2.0,
+                             max_hedge_rate: float = 0.5) -> List[AlertRule]:
+    """The shipped gray-failure (fail-slow) alert pack
+    (docs/reliability.md §11). Series are the tsdb-sampled detector
+    surfaces: conviction/hedge counters and the imbalance/probation
+    gauges. Convictions page immediately (an eviction already happened —
+    the hold is on the *band* alerts, which watch symptoms that may
+    self-resolve)."""
+    return [
+        AlertRule(name="gray_straggler_convicted",
+                  series="elastic_stragglers_evicted_total",
+                  kind="rate", op=">", threshold=0.0,
+                  window_s=window_s, for_s=0.0, severity="page",
+                  description="the elastic leader convicted and evicted a "
+                              "straggler — a host is fail-slow (flight "
+                              "bundle trigger straggler_convicted has the "
+                              "verdict)"),
+        AlertRule(name="gray_stage_imbalance_sustained",
+                  series="pipeline_stage_imbalance",
+                  op=">", threshold=max_imbalance, fn="min_over_time",
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="max/median pipeline stage wall has held "
+                              "above the band for the whole window — a "
+                              "stage is dragging the pipeline (rebalance "
+                              "should fire; if it did and imbalance "
+                              "persists, the host itself is sick)"),
+        AlertRule(name="gray_hedge_rate_high",
+                  series="serve_router_hedges_total",
+                  kind="rate", op=">", threshold=max_hedge_rate,
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="hedged requests per second above the band "
+                              "— tail latency is chronically bad, not a "
+                              "blip (check replica probation + p99)"),
+        AlertRule(name="gray_replica_probation",
+                  series="serve_router_probation_replicas",
+                  op=">=", threshold=1.0, fn="min_over_time",
+                  window_s=window_s, for_s=for_s, severity="ticket",
+                  description="at least one serving replica has sat in "
+                              "slow-replica probation for the whole "
+                              "window — it is not recovering on its own"),
+    ]

@@ -93,6 +93,12 @@ _HEALTH_FLAGS = (
     # the classifier currently blames, next to the 200/503 verdict
     "goodput_fraction", "goodput_bottleneck_state",
     "goodput_unattributed_seconds",
+    # gray-failure plane (resilience/slowness.py; docs/reliability.md
+    # §11): fail-slow verdicts next to the fail-stop ones
+    "elastic_stragglers_evicted_total", "elastic_slow_leader_total",
+    "pipeline_rebalances_total", "pipeline_stage_imbalance",
+    "serve_router_hedges_total", "serve_router_hedge_wins_total",
+    "serve_router_probation_replicas", "feed_worker_recycled_total",
 )
 
 

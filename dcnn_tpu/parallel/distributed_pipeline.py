@@ -57,6 +57,24 @@ Failure semantics — self-healing (ISSUE 13; elastic-DP's contract,
 An ERROR_REPORT from a live worker (its own exception — bad input, OOM)
 still raises :class:`PipelineWorkerError` after an ``abort()``: a
 deterministic remote error must surface, not spin the re-deploy loop.
+
+Gray failure (fail-slow, ISSUE 19; docs/reliability.md §11): a stage that
+stays alive but runs 10x slower defeats all of the above — it keeps
+beating and answering probes while capping the whole pipeline at its
+pace. :meth:`maybe_rebalance` (called between batches) feeds measured
+per-stage walls (``collect_load_reports``, needs ``track_load``) into a
+shared :class:`~dcnn_tpu.resilience.slowness.SlownessDetector`; a stage
+convicted as a *sustained* relative outlier triggers a **rebalance, not
+an eviction** (stages are unique — there is no survivor holding the same
+layers): live weights are gathered (exact momentum, zero rewind), the
+layer ranges are re-split proportional to the measured walls
+(:class:`~dcnn_tpu.parallel.partitioner.MeasuredPartitioner`) and
+re-shipped through the same generation-fenced machinery as a recovery.
+A fleet-wide slowdown moves every stage's wall together — no outlier,
+no rebalance. ``pipeline_rebalances_total`` /
+``pipeline_stage_imbalance`` + a ``pipeline_rebalance`` flight bundle
+are the evidence; the ``pipeline.slow_stage`` delay point
+(``FaultPlan.slow``, worker.py dispatch) is the injection hook.
 """
 
 from __future__ import annotations
@@ -78,8 +96,9 @@ from ..obs import get_registry, get_tracer
 from ..ops.losses import LOSSES
 from ..optim.optimizers import Optimizer
 from ..resilience import faults as _faults
+from ..resilience.slowness import SlownessConfig, SlownessDetector
 from .comm import Channel, Inbox, connect, parse_addr
-from .partitioner import NaivePartitioner, Partitioner
+from .partitioner import MeasuredPartitioner, NaivePartitioner, Partitioner
 
 
 class PipelineWorkerError(RuntimeError):
@@ -212,6 +231,7 @@ class DistributedPipelineCoordinator:
                  recover: bool = True, max_recoveries: int = 8,
                  min_stages: int = 1, journal_limit: int = 64,
                  fault_plan: Optional[_faults.FaultPlan] = None,
+                 slow_config: Optional[SlownessConfig] = None,
                  flight=None, clock=time.monotonic, registry=None):
         self.model = model
         self.optimizer = optimizer
@@ -271,9 +291,19 @@ class DistributedPipelineCoordinator:
         self._init_weights = None                 # last-resort restore target
         self._tpl_params = None                   # full-model tree templates
         self._tpl_state = None
+        # gray-failure rebalance (maybe_rebalance; docs/reliability.md
+        # §11): stages are unique, so min_peers relaxes to 2 — the hard
+        # rule still holds (a fleet-wide slowdown moves every stage's
+        # wall together, leaving no outlier to convict)
+        self.slowness = SlownessDetector(
+            SlownessConfig.from_env(
+                slow_config if slow_config is not None
+                else SlownessConfig(min_peers=2)),
+            clock=clock)
         self.stats: Dict[str, Any] = {
             "recoveries": 0, "respawns": 0, "detection_s": [],
-            "recovery_s": [], "replayed_batches": 0, "batches_lost": 0}
+            "recovery_s": [], "replayed_batches": 0, "batches_lost": 0,
+            "rebalances": 0}
 
         def _lg(pred, tgt):
             return jax.value_and_grad(self.loss_fn)(pred, tgt)
@@ -740,6 +770,89 @@ class DistributedPipelineCoordinator:
             self._load_nonce = None
         by_stage = {m["stage_id"]: m["report"] for m, _ in got}
         return [by_stage[i] for i in range(self.num_stages)]
+
+    # -- gray-failure rebalance (resilience/slowness.py; ISSUE 19) --
+    def stage_walls(self) -> List[float]:
+        """Measured per-stage wall (avg fwd + bwd ms) from one
+        load-report round — the rebalance cost signal. Needs
+        ``track_load`` on the stages; unmeasured stages report 0."""
+        reports = self.collect_load_reports()
+        return [float(r.get("avg_forward_ms", 0.0))
+                + float(r.get("avg_backward_ms", 0.0)) for r in reports]
+
+    def maybe_rebalance(self) -> bool:
+        """Gray-failure mitigation for the pipeline leg: poll measured
+        per-stage walls into the shared slowness detector and, once a
+        stage is convicted as a *sustained* relative outlier (probation
+        → convict with dwell, docs/reliability.md §11), repartition the
+        layer ranges proportional to the measured walls through the
+        recovery machinery — gather live weights (exact momentum, zero
+        rewind), re-ship under :class:`MeasuredPartitioner`. Rebalance,
+        never evict: stages are unique. Call between batches (buffering
+        joins). Returns True iff a rebalance actually shipped."""
+        walls = self.stage_walls()
+        measured = [w for w in walls if w > 0.0]
+        if measured:
+            s = sorted(measured)
+            mid = len(s) // 2
+            med = s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+            self._reg.gauge(
+                "pipeline_stage_imbalance",
+                "max/median measured per-stage wall ratio").set(
+                    max(measured) / med if med > 0 else 0.0)
+        for sid, w in enumerate(walls):
+            if w > 0.0:
+                self.slowness.observe(f"stage{sid}", w)
+        convicted = [tr for tr in self.slowness.evaluate()
+                     if tr["to"] == "convicted"]
+        if not convicted:
+            return False
+        from ..obs.flight import resolve_flight_recorder
+        resolve_flight_recorder(self._flight).record(
+            "pipeline_rebalance",
+            reasons=[f"{tr['component']} wall {tr['ewma']:.2f}ms vs "
+                     f"fleet median {tr['median']:.2f}ms — sustained "
+                     f"outlier" for tr in convicted],
+            config={"generation": self._gen, "batch": self._batch,
+                    "stages": self.num_stages,
+                    "partitions": [list(p) for p in self.partitions]},
+            extra={"walls_ms": walls,
+                   "slowness": self.slowness.snapshot()},
+            registry=self._reg)
+        ok = self._with_recovery(lambda: self._do_rebalance(walls))
+        if ok:
+            self.stats["rebalances"] += 1
+            self._reg.counter(
+                "pipeline_rebalances_total",
+                "gray-failure layer-range rebalances shipped").inc()
+            # the partitioning changed: every stage's wall now means
+            # something new, so the old scores must not linger
+            for sid in range(len(walls)):
+                self.slowness.forget(f"stage{sid}")
+        return ok
+
+    def _do_rebalance(self, walls: List[float]) -> bool:
+        """One rebalance attempt: runs inside ``_with_recovery`` so a
+        stage dying mid-gather re-enters the normal recovery (which
+        replays the journal) and then retries this. No journal replay
+        here — the gathered weights are at the current batch vintage."""
+        part = MeasuredPartitioner(self.partitions, walls)
+        new_parts = part.get_partitions(self.model, self.num_stages)
+        if new_parts == self.partitions:
+            return False  # layer granularity can't improve on this split
+        replies = self._gather_stage_blobs()
+        full = self._assemble_full(replies, self.partitions,
+                                   expect_batch=self._batch)
+        if full is None:
+            return False  # inconsistent gather between batches: never guess
+        params, state, opt = full
+        self.abort()  # gen bump: same straggler fence as a recovery
+        # keep the measured cost model installed: later recoveries (and
+        # their repartitions over fewer workers) reuse the best-known
+        # per-layer walls instead of reverting to FLOP estimates
+        self.partitioner = part
+        self._ship_stages(params, state, opt)
+        return True
 
     # -- per-layer profiling broadcast (coordinator.hpp:384-403) --
     # dcnn: protocol=pipe.c2w role=sender frames=PRINT_PROFILING,CLEAR_PROFILING

@@ -54,9 +54,33 @@ streams — re-derived, not replayed.
 
 Fault points: ``elastic.heartbeat`` (armed with ``InjectedCrash`` = the
 kill-a-host simulation), ``elastic.reconfigure`` (a crash *during*
-recovery). Controllers accept a per-instance
+recovery), and the delay hook ``elastic.slow_peer`` (``FaultPlan.slow``
+= the gray-failure simulation: this peer's local compute runs slow
+without dying). Controllers accept a per-instance
 :class:`~dcnn_tpu.resilience.faults.FaultPlan` so multi-peer in-process
-tests can kill one peer without arming the process-global slot.
+tests can kill (or slow) one peer without arming the process-global slot.
+
+**Straggler eviction** (``config.slow_detect``; docs/reliability.md §11):
+every peer measures its *local-compute* wall per step — the window
+before :meth:`ElasticController._exchange`, because the lockstep
+exchange equalizes full-step walls across the fleet — and piggybacks it
+as ``wall_s`` on its BEAT and GRADS frames. The generation **leader**
+(and only the leader: a follower that convicted and unwound would stop
+beating and be evicted as the apparently-dead one itself) feeds a
+:class:`~dcnn_tpu.resilience.slowness.SlownessDetector` and, on a
+conviction, marks the straggler dead and raises
+:class:`~.multihost.PeerLostError` — from there the mitigation IS the
+existing generation-fenced reconfiguration: reshard over survivors,
+zero lost batches, the evicted host told via RECONF
+(``include_dead=True``) and exiting on :class:`EvictedError`. A
+fleet-wide slowdown moves the median with it and convicts nobody; a
+slow *leader* is the documented blind spot (it cannot evict itself —
+the fleet still makes progress at the degraded rate, and the alert pack
+surfaces the verdict for the operator). An evicted host may rejoin at a
+later generation via the segment-restart path (fresh controllers,
+``fit(resume=True)``) once a recovery probe
+(:meth:`~dcnn_tpu.resilience.slowness.SlownessDetector.probe_ok`)
+passes.
 """
 
 from __future__ import annotations
@@ -280,6 +304,13 @@ class Membership:
             self._detections.append((rank, now - self._last_heard[rank]))
         self._reg.counter("elastic_peers_lost_total",
                           "DP peers lost (closed or timed out)").inc()
+
+    def evict(self, rank: int) -> None:
+        """Administratively declare ``rank`` dead — the gray-failure
+        conviction path. The next reconfiguration's survivor set excludes
+        it, and the RECONF ``include_dead`` delivery tells the (alive but
+        convicted) host to exit via :class:`EvictedError`."""
+        self._mark_dead(rank)
 
     def heard(self, rank: Optional[int]) -> None:
         if rank is None:
@@ -517,7 +548,8 @@ class ElasticController:
         self.step_log: List[Dict[str, Any]] = []
         self.stats: Dict[str, Any] = {
             "reconfigures": 0, "peers_lost": 0, "detection_s": [],
-            "restore_s": [], "reconfigure_s": [], "steps_lost": []}
+            "restore_s": [], "reconfigure_s": [], "steps_lost": [],
+            "stragglers_evicted": 0}
         self.poll_s = 0.02
         self._grad_steps: Dict[int, Callable] = {}  # local mb count -> jit
         self._apply = make_elastic_apply_step(optimizer)
@@ -530,6 +562,22 @@ class ElasticController:
         # carrier — a reconfiguration (and the steps of the generation it
         # establishes) renders as ONE cross-host timeline
         self._gen_ctx = None
+        # gray-failure detection (docs/reliability.md §11): every peer
+        # runs a detector over the wall_s metas it hears, but only the
+        # LEADER convicts (see the module docstring for why)
+        if getattr(config, "slow_detect", False):
+            from ..resilience.slowness import (SlownessConfig,
+                                               SlownessDetector)
+            self.slowness: Optional[SlownessDetector] = SlownessDetector(
+                SlownessConfig.from_env(SlownessConfig(
+                    dwell_s=getattr(config, "slow_dwell_s", 1.0),
+                    ratio=getattr(config, "slow_ratio", 2.0),
+                    mad_k=getattr(config, "slow_mad_k", 4.0),
+                    min_samples=getattr(config, "slow_min_samples", 3))),
+                clock=clock)
+        else:
+            self.slowness = None
+        self._last_wall: Optional[float] = None
         # set by preempt() (any thread); checked at every step beat
         self._preempt = threading.Event()
         self._preempt_reason = "preempted"
@@ -540,6 +588,18 @@ class ElasticController:
             self._faults_plan.trip(point, **ctx)
         else:
             _faults.trip(point, **ctx)
+
+    def _slow_sleep(self, point: str, base_s: float, **ctx) -> float:
+        """Delay-injection twin of :meth:`_trip` (``FaultPlan.slow``):
+        sleeps the armed extra wall INSIDE the caller's timing window so
+        the fleet experiences the slowness exactly as a degraded host
+        would produce it. Returns the extra seconds slept."""
+        extra = _faults.slowdown(point, base_s, **ctx)
+        if self._faults_plan is not None:
+            extra += self._faults_plan.slowdown(point, base_s, **ctx)
+        if extra > 0.0:
+            time.sleep(extra)
+        return extra
 
     @property
     def generation(self) -> int:
@@ -730,6 +790,10 @@ class ElasticController:
             with tracer.span("elastic.step", track="elastic",
                              parent=self._gen_ctx, rank=self.rank,
                              gen=self.gen, step=gs):
+                # local-compute wall: measured BEFORE _exchange, because
+                # the lockstep exchange equalizes full-step walls across
+                # the fleet — only this window discriminates a straggler
+                t_local = self._clock()
                 # the put above shipped the loader's wire dtype (uint8
                 # pixels for image loaders — 1/4 the H2D bytes); decode
                 # on device per the scale contract (identity for floats)
@@ -742,8 +806,16 @@ class ElasticController:
                     "g": grad_sum,
                     "s": jax.tree_util.tree_map(lambda v: a * v, state_new),
                 })[0])
+                self._slow_sleep("elastic.slow_peer",
+                                 self._clock() - t_local,
+                                 gen=self.gen, step=gs)
+                wall = self._clock() - t_local
+                self._last_wall = wall
+                if self.slowness is not None:
+                    self.slowness.observe(f"rank{self.rank}", wall)
                 avg_flat, mean_loss = self._exchange(
-                    flat, float(loss_sum), a, gs)
+                    flat, float(loss_sum), a, gs, wall_s=wall)
+                self._check_slowness()
                 mean = self._unravel(jnp.asarray(avg_flat))
                 new_params, new_opt = self._apply(
                     ts.params, ts.opt_state, mean["g"], self.lr)
@@ -796,13 +868,72 @@ class ElasticController:
         # deterministic per-step beat — the elastic.heartbeat fault point
         # armed with InjectedCrash here IS the kill-a-host simulation
         self._trip("elastic.heartbeat", gen=self.gen, step=gs)
-        self.membership.set_beat_meta(gen=self.gen, step=gs)
+        # wall_s piggybacks the last local-compute wall so the leader's
+        # slowness detector hears every peer even between GRADS frames
+        self.membership.set_beat_meta(gen=self.gen, step=gs,
+                                      wall_s=self._last_wall)
         self.membership.beat_all()
+
+    def _check_slowness(self) -> None:
+        """Leader-only gray-failure conviction sweep. Every peer's
+        detector hears the fleet's walls, but only the leader acts: a
+        follower that convicted and unwound to await a RECONF would stop
+        beating and be timed out as the apparently-dead peer itself. A
+        convicted straggler is marked dead and surfaced as
+        :class:`~.multihost.PeerLostError` — the mitigation is the
+        normal generation-fenced reconfiguration."""
+        det = self.slowness
+        if det is None:
+            return
+        transitions = det.evaluate()
+        if not self.is_leader():
+            return
+        for tr in transitions:
+            if tr["to"] != "convicted":
+                continue
+            victim = int(str(tr["component"])[len("rank"):])
+            if victim == self.rank:
+                # documented limitation: the leader cannot evict itself.
+                # Surface the verdict (alert pack + flight bundle) and
+                # keep training at the degraded rate.
+                self._reg.counter(
+                    "elastic_slow_leader_total",
+                    "leader self-convictions (surfaced, never "
+                    "self-evicted)").inc()
+                from ..obs.flight import resolve_flight_recorder
+                resolve_flight_recorder().record(
+                    "straggler_convicted", registry=self._reg,
+                    reasons=[f"leader rank {victim} is the straggler — "
+                             f"cannot self-evict"],
+                    extra={"victim": victim, "gen": self.gen,
+                           "self_conviction": True,
+                           "slowness": det.snapshot()})
+                continue
+            reason = (f"rank {victim} convicted as straggler: local wall "
+                      f"EWMA {tr['ewma']:.6g}s vs fleet median "
+                      f"{tr['median']:.6g}s")
+            from ..obs.flight import resolve_flight_recorder
+            resolve_flight_recorder().record(
+                "straggler_convicted", registry=self._reg,
+                reasons=[reason],
+                config={"slow_dwell_s": det.config.dwell_s,
+                        "slow_ratio": det.config.ratio,
+                        "slow_mad_k": det.config.mad_k},
+                extra={"victim": victim, "gen": self.gen,
+                       "slowness": det.snapshot()})
+            self._reg.counter(
+                "elastic_stragglers_evicted_total",
+                "DP peers evicted on gray-failure conviction").inc()
+            self.stats["stragglers_evicted"] += 1
+            det.forget(str(tr["component"]))
+            self.membership.evict(victim)
+            raise PeerLostError("straggler eviction", reason, [victim])
 
     # -- gradient exchange -------------------------------------------------
     # dcnn: protocol=elastic.mesh role=sender
     def _exchange(self, flat: np.ndarray, loss_sum: float, local_mb: int,
-                  gs: int) -> Tuple[np.ndarray, float]:
+                  gs: int, wall_s: Optional[float] = None
+                  ) -> Tuple[np.ndarray, float]:
         """All-reduce of the flat (grad-sum ‖ scaled-state) vector over the
         surviving world via the generation leader; returns the global
         /K mean. Every peer returns bit-identical bytes (the mean is
@@ -843,7 +974,7 @@ class ElasticController:
         self.membership.send(
             leader, "GRADS",
             {"gen": self.gen, "step": gs, "loss": loss_sum,
-             "mb": local_mb}, array=flat)
+             "mb": local_mb, "wall_s": wall_s}, array=flat)
         _cmd, meta, payload = self._recv(
             {"GSUM"}, deadline, {leader},
             match=lambda m: m.get("step") == gs)
@@ -882,6 +1013,18 @@ class ElasticController:
                 self.membership.check_peers()
                 continue
             self.membership.heard(meta.get("rank"))
+            if self.slowness is not None:
+                # harvest the piggybacked local-compute walls (BEAT and
+                # GRADS metas both carry wall_s) — feeding is universal,
+                # convicting is leader-only (_check_slowness). Dead peers
+                # are excluded: a convicted straggler keeps stepping (and
+                # beating) until its RECONF arrives, and those stale walls
+                # would re-seed the component ``forget`` just erased and
+                # convict the same ghost a second time
+                w, r = meta.get("wall_s"), meta.get("rank")
+                if (w is not None and r is not None
+                        and r not in self.membership.dead()):
+                    self.slowness.observe(f"rank{r}", float(w))
             if cmd == "BEAT":
                 continue
             mgen = meta.get("gen", -1)

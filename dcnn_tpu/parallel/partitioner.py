@@ -47,6 +47,44 @@ class NaivePartitioner(Partitioner):
         return parts
 
 
+def _layer_flops(model: Sequential) -> List[float]:
+    """Per-layer ``forward + backward`` complexity estimates (+1 so a
+    zero-cost layer still claims a slot in the walk)."""
+    shapes = model.layer_shapes()
+    return [
+        layer.forward_complexity(shape) + layer.backward_complexity(shape) + 1
+        for layer, shape in zip(model.layers, shapes)
+    ]
+
+
+def _greedy_walk(costs: List[float], num_stages: int) -> List[Partition]:
+    """Greedy prefix walk targeting equal cumulative-cost slices: each
+    stage extends while staying closer to its target than stopping would,
+    always leaving enough layers for the remaining stages; the last stage
+    absorbs any remainder."""
+    total = sum(costs)
+    n = len(costs)
+    parts: List[Partition] = []
+    start = 0
+    acc = 0.0
+    for s in range(num_stages):
+        target = total * (s + 1) / num_stages
+        end = start + 1  # at least one layer per stage
+        acc += costs[start]
+        while end < n - (num_stages - s - 1):
+            next_acc = acc + costs[end]
+            if abs(next_acc - target) <= abs(acc - target):
+                acc = next_acc
+                end += 1
+            else:
+                break
+        parts.append((start, end))
+        start = end
+    if parts[-1][1] != n:
+        parts[-1] = (parts[-1][0], n)
+    return parts
+
+
 class FlopBalancedPartitioner(Partitioner):
     """Split minimizing per-stage FLOP imbalance.
 
@@ -58,32 +96,40 @@ class FlopBalancedPartitioner(Partitioner):
 
     def get_partitions(self, model: Sequential, num_stages: int) -> List[Partition]:
         self._validate(model, num_stages)
-        shapes = model.layer_shapes()
-        costs = [
-            layer.forward_complexity(shape) + layer.backward_complexity(shape) + 1
-            for layer, shape in zip(model.layers, shapes)
-        ]
-        total = sum(costs)
-        n = len(costs)
-        parts: List[Partition] = []
-        start = 0
-        acc = 0.0
-        for s in range(num_stages):
-            target = total * (s + 1) / num_stages
-            end = start + 1  # at least one layer per stage
-            acc += costs[start]
-            # extend while staying closer to the target than stopping, and
-            # leaving enough layers for the remaining stages
-            while end < n - (num_stages - s - 1):
-                next_acc = acc + costs[end]
-                if abs(next_acc - target) <= abs(acc - target):
-                    acc = next_acc
-                    end += 1
-                else:
-                    break
-            parts.append((start, end))
-            start = end
-        # last stage must absorb any remainder
-        if parts[-1][1] != n:
-            parts[-1] = (parts[-1][0], n)
-        return parts
+        return _greedy_walk(_layer_flops(model), num_stages)
+
+
+class MeasuredPartitioner(Partitioner):
+    """Split proportional to *measured* per-stage walls — the gray-failure
+    rebalance cost model (docs/reliability.md §11).
+
+    A FLOP estimate cannot see a degraded device: a stage on a
+    thermally-throttled host is "balanced" by complexity yet dominates
+    the measured critical path. This partitioner takes the wall each
+    *current* stage actually reported (``collect_load_reports``), spreads
+    it over that stage's layer range using the FLOP estimates as
+    within-stage weights, and re-runs the same greedy prefix walk over
+    those measured per-layer costs — a stage that ran slow sheds layers
+    in exact proportion. Stages with no measurement (wall ``<= 0``) keep
+    their raw FLOP costs, so the walk degrades toward
+    :class:`FlopBalancedPartitioner` when reports are missing."""
+
+    def __init__(self, partitions: List[Partition],
+                 stage_walls: List[float]):
+        if len(partitions) != len(stage_walls):
+            raise ValueError(
+                f"{len(partitions)} partitions vs {len(stage_walls)} walls")
+        self.partitions = [tuple(p) for p in partitions]
+        self.stage_walls = [float(w) for w in stage_walls]
+
+    def get_partitions(self, model: Sequential, num_stages: int) -> List[Partition]:
+        self._validate(model, num_stages)
+        flops = _layer_flops(model)
+        costs = [float(c) for c in flops]
+        for (start, end), wall in zip(self.partitions, self.stage_walls):
+            stage_flops = sum(flops[start:end])
+            if wall <= 0.0 or stage_flops <= 0:
+                continue
+            for i in range(start, end):
+                costs[i] = wall * flops[i] / stage_flops
+        return _greedy_walk(costs, num_stages)

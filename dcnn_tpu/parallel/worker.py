@@ -116,6 +116,19 @@ class StageWorker:
         else:
             _faults.trip(point, **ctx)
 
+    def _slowdown(self, point: str, base_s: float, **ctx) -> float:
+        """Delay-injection twin of :meth:`_trip` (``FaultPlan.slow``):
+        sleeps the armed extra INSIDE the dispatch and folds it into the
+        stage's load tracker, so the wall the coordinator's gray-failure
+        rebalancer reads (``collect_load_reports``) actually shows the
+        injected slowness — a fail-slow stage, not a fail-stop one."""
+        extra = _faults.slowdown(point, base_s, **ctx)
+        if self._faults_plan is not None:
+            extra += self._faults_plan.slowdown(point, base_s, **ctx)
+        if extra > 0.0:
+            time.sleep(extra)
+        return extra
+
     def _coord_chan(self) -> Optional[Channel]:
         with self._lock:
             return self.coord
@@ -343,9 +356,15 @@ class StageWorker:
                 # batch start: snapshot layer state so ABORT can roll back
                 # BN running stats mutated by this batch's forwards
                 self._state_snap = self.stage.snapshot_state()
+            t0 = self._clock()
             y = self.stage.forward(mb_id, np.asarray(payload), rng,
                                    training=training)
             out = np.asarray(y)
+            extra = self._slowdown("pipeline.slow_stage",
+                                   self._clock() - t0, cmd="FORWARD_JOB",
+                                   mb=mb_id, stage=self._sid())
+            if extra > 0.0:
+                self.stage.load.forward_ms += extra * 1e3
             if self.is_last:
                 self._coord_chan().send(
                     "FORWARD_RESULT",
@@ -358,7 +377,13 @@ class StageWorker:
 
         if cmd == "BACKWARD_JOB":
             mb_id = meta["mb_id"]
+            t0 = self._clock()
             xgrad = self.stage.backward(mb_id, np.asarray(payload))
+            extra = self._slowdown("pipeline.slow_stage",
+                                   self._clock() - t0, cmd="BACKWARD_JOB",
+                                   mb=mb_id, stage=self._sid())
+            if extra > 0.0:
+                self.stage.load.backward_ms += extra * 1e3
             if self.is_first:
                 self._coord_chan().send(
                     "BACKWARD_DONE",

@@ -49,6 +49,8 @@ _EXPORTS = {
     "FaultPlan": ("faults", "FaultPlan"),
     "InjectedFault": ("faults", "InjectedFault"),
     "InjectedCrash": ("faults", "InjectedCrash"),
+    "SlownessConfig": ("slowness", "SlownessConfig"),
+    "SlownessDetector": ("slowness", "SlownessDetector"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -89,7 +89,27 @@ Trip points wired in this PR (grep for ``faults.trip`` to enumerate):
 ``aot.load``                    fail an executable-cache lookup before its
                                 read — the warm path must degrade to a
                                 transparent recompile, never an error
+``elastic.slow_peer``           delay hook (``FaultPlan.slow``) in the
+                                elastic step's local-compute window — makes
+                                this peer a straggler without killing it
+                                (``parallel/elastic.py``)
+``pipeline.slow_stage``         delay hook in a TCP stage worker's dispatch
+                                path — one slow stage drags the whole
+                                pipeline (``parallel/worker.py``)
+``serve.slow_replica``          delay hook in a replica's engine dispatch —
+                                the gray-failure serving fixture
+                                (``serve/replica.py``)
+``feed.slow_worker``            delay hook in a feed worker's shard-prep
+                                path (``data/workers.py``)
 ==============================  ==============================================
+
+Fail-stop points raise; the four ``slow_*`` points are **delay** hooks:
+production code calls :func:`slowdown` (or ``plan.slowdown``) with the
+wall it is about to spend and sleeps the returned extra seconds — zero
+when disarmed. ``FaultPlan.slow(point, factor=10)`` scales the measured
+wall (a 10x-slow component); ``delay_s=`` adds a fixed stall instead.
+Both honor the same deterministic ``at=`` / ``times=`` windowing as
+:meth:`FaultPlan.arm`.
 
 This module is stdlib-only and import-safe from any layer.
 """
@@ -141,6 +161,13 @@ class FaultPlan:
         self._armed: Dict[str, Tuple[Optional[int], Optional[int],
                                      Type[BaseException]]] = {}
         self._counts: Dict[str, int] = {}
+        # delay-injection arms (FaultPlan.slow): point -> (at, times,
+        # factor, delay_s); counters separate from the fail-stop ones so
+        # a point can carry both kinds without aliasing windows
+        self._slow_armed: Dict[str, Tuple[Optional[int], Optional[int],
+                                          Optional[float],
+                                          Optional[float]]] = {}  # dcnn: guarded_by=_lock
+        self._slow_counts: Dict[str, int] = {}  # dcnn: guarded_by=_lock
 
     def arm(self, point: str, *, at: Optional[int] = None,
             times: Optional[int] = None,
@@ -186,6 +213,63 @@ class FaultPlan:
         peer's controller — the global :func:`install` slot would fault
         every peer at once."""
         self._check(point, context)
+
+    # -- delay injection (fail-slow, not fail-stop) ------------------------
+    def slow(self, point: str, *, factor: Optional[float] = None,
+             delay_s: Optional[float] = None, at: Optional[int] = None,
+             times: Optional[int] = None) -> "FaultPlan":
+        """Arm ``point`` as a **delay** hook: every matching
+        :meth:`slowdown` query returns extra seconds for the call site to
+        sleep. Exactly one of ``factor`` (scale the measured wall — a
+        ``factor=10`` component runs 10x slow) or ``delay_s`` (fixed
+        stall) must be given; ``at``/``times`` window invocations exactly
+        like :meth:`arm`."""
+        if (factor is None) == (delay_s is None):
+            raise ValueError(
+                "FaultPlan.slow wants exactly one of factor= or delay_s=")
+        if factor is not None and factor < 1.0:
+            raise ValueError(f"factor must be >= 1, got {factor}")
+        if delay_s is not None and delay_s < 0.0:
+            raise ValueError(f"delay_s must be >= 0, got {delay_s}")
+        with self._lock:
+            self._slow_armed[point] = (at, times, factor, delay_s)
+        return self
+
+    def unslow(self, point: str) -> "FaultPlan":
+        """Disarm a :meth:`slow` point — the fault "clears" (recovery /
+        probation-rejoin fixtures)."""
+        with self._lock:
+            self._slow_armed.pop(point, None)
+        return self
+
+    def slow_count(self, point: str) -> int:
+        with self._lock:
+            return self._slow_counts.get(point, 0)
+
+    def slowdown(self, point: str, base_s: float = 0.0,
+                 **context) -> float:
+        """Per-plan delay query: extra seconds the call site should
+        sleep on top of the ``base_s`` wall it measured — 0.0 unless
+        :meth:`slow` armed this point and the invocation window matches.
+        Deterministic like :meth:`trip`; never raises."""
+        with self._lock:
+            n = self._slow_counts.get(point, 0)
+            self._slow_counts[point] = n + 1
+            spec = self._slow_armed.get(point)
+            if spec is None:
+                return 0.0
+            at, times, factor, delay_s = spec
+            if at is not None and n < at:
+                return 0.0
+            if times is not None:
+                times -= 1
+                if times <= 0:
+                    self._slow_armed.pop(point, None)
+                else:
+                    self._slow_armed[point] = (at, times, factor, delay_s)
+        if delay_s is not None:
+            return delay_s
+        return base_s * max(float(factor) - 1.0, 0.0)
 
     # -- corruption utility (not a trip point: tests call it directly) --
     def bit_flip(self, path: str) -> Tuple[int, int]:
@@ -238,3 +322,15 @@ def trip(point: str, **context) -> None:
     this point/invocation. Free (one global check) otherwise."""
     if _ACTIVE is not None:
         _ACTIVE._check(point, context)
+
+
+def slowdown(point: str, base_s: float = 0.0, **context) -> float:
+    """Production-side delay hook (the fail-slow twin of :func:`trip`):
+    extra seconds to sleep at this point — 0.0 (one global check, no
+    allocation) unless an installed plan armed it via
+    :meth:`FaultPlan.slow`. Call sites sleep the return value INSIDE
+    their measured timing window so detectors see the slowness exactly
+    as a degraded host would produce it."""
+    if _ACTIVE is not None:
+        return _ACTIVE.slowdown(point, base_s, **context)
+    return 0.0

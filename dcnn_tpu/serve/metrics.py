@@ -287,6 +287,11 @@ class RouterMetrics:
     ``serve_router_rejoins_total``, ``serve_router_replica_errors_total``,
     ``serve_router_swaps_total``, ``serve_router_swap_failures_total``,
     ``serve_router_promotions_total``, ``serve_router_rollbacks_total``).
+    Gray-failure serving (ISSUE 19): ``serve_router_hedges_total`` /
+    ``serve_router_hedge_wins_total`` count tail-latency hedging,
+    ``serve_router_probations_total`` /
+    ``serve_router_probation_rejoins_total`` + gauge
+    ``serve_router_probation_replicas`` track slow-replica probation.
     """
 
     def __init__(self, *, window: int = 4096,
@@ -345,6 +350,19 @@ class RouterMetrics:
             "decommissions that had to force-sweep outstanding work "
             "(drain timeout or death mid-drain); the work failed typed "
             "and re-admitted — never silently dropped")
+        self._hedges = r.counter(
+            "serve_router_hedges_total",
+            "tail requests duplicated to a second replica after the "
+            "hedge delay")
+        self._hedge_wins = r.counter(
+            "serve_router_hedge_wins_total",
+            "hedged requests where the duplicate answered first")
+        self._probations = r.counter(
+            "serve_router_probations_total",
+            "replicas demoted to probation as sustained latency outliers")
+        self._probation_rejoins = r.counter(
+            "serve_router_probation_rejoins_total",
+            "probation replicas released after a clean probe")
         self.replicas = r.gauge("serve_router_replicas",
                                 "replicas known to the router")
         self.replicas_routable = r.gauge(
@@ -361,6 +379,9 @@ class RouterMetrics:
             "replicas currently serving the canary version")
         self.version = r.gauge("serve_router_version",
                                "fleet model version (checkpoint step)")
+        self.probation_replicas = r.gauge(
+            "serve_router_probation_replicas",
+            "replicas currently held in latency probation")
         self._init_local()
 
     def _init_local(self) -> None:
@@ -419,6 +440,30 @@ class RouterMetrics:
         self._decommissions.inc()
         if not clean:
             self._decommission_sweeps.inc()
+
+    def record_hedge(self) -> None:
+        self._hedges.inc()
+
+    def record_hedge_win(self) -> None:
+        self._hedge_wins.inc()
+
+    def record_probation(self) -> None:
+        self._probations.inc()
+
+    def record_probation_rejoin(self) -> None:
+        self._probation_rejoins.inc()
+
+    def p99_ms(self, min_samples: int = 20) -> Optional[float]:
+        """Exact windowed p99 across ALL priority classes — the hedge
+        delay's base signal. ``None`` until ``min_samples`` completions
+        exist (a hedge delay derived from two data points would fire on
+        noise)."""
+        with self._lock:
+            lat = sorted(v for p in PRIORITIES for v in self._lat_s[p])
+        if len(lat) < max(min_samples, 1):
+            return None
+        i = min(int(0.99 * (len(lat) - 1) + 0.5), len(lat) - 1)
+        return lat[i] * 1e3
 
     # -- export --
     def snapshot(self) -> Dict[str, object]:

@@ -98,7 +98,14 @@ class _TrippedEngine:
         except InjectedCrash:
             self._replica._note_crash("injected crash mid-infer")
             raise
-        return self._engine.run_padded(x)
+        t0 = self._replica._clock()
+        out = self._engine.run_padded(x)
+        # gray-failure injection (FaultPlan.slow): stretch this batch's
+        # engine wall INSIDE the dispatch — the replica stays alive and
+        # healthy-looking while every completion latency it reports grows
+        self._replica._slowdown("serve.slow_replica",
+                                self._replica._clock() - t0)
+        return out
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -161,6 +168,19 @@ class LocalReplica:
         _faults.trip(point, replica=self.name, **ctx)
         if self._plan is not None:
             self._plan.trip(point, replica=self.name, **ctx)
+
+    def _slowdown(self, point: str, base_s: float, **ctx) -> float:
+        """Delay-injection twin of :meth:`_trip` (``FaultPlan.slow``):
+        sleeps the armed extra inside the dispatch, so the latency the
+        router observes — and judges probation/hedging on — actually
+        grows."""
+        extra = _faults.slowdown(point, base_s, replica=self.name, **ctx)
+        if self._plan is not None:
+            extra += self._plan.slowdown(point, base_s,
+                                         replica=self.name, **ctx)
+        if extra > 0.0:
+            time.sleep(extra)
+        return extra
 
     def _note_crash(self, reason: str) -> None:
         """Mark this replica dead without tearing anything down — called

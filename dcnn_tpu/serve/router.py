@@ -42,10 +42,33 @@ series), and :meth:`Router.start_telemetry` exposes the router's own
 no replica is routable, when the router is draining, or when a sweep
 finds the fleet degraded below ``min_routable``.
 
+Gray failure (fail-slow, ISSUE 19; docs/reliability.md §11): a replica
+that stays alive but answers 10x slower defeats guarantees 2 and 3 — it
+passes every health probe while dragging the tail. Two mitigations,
+both off by default:
+
+- **Hedged requests** (``hedge=True`` / ``DCNN_HEDGE``; "The Tail at
+  Scale", Dean & Barroso): :meth:`Router.check_hedges` duplicates an
+  in-flight request older than the hedge delay (``hedge_multiplier`` ×
+  the fleet-wide windowed p99, floored at ``hedge_min_s``) to a second
+  replica that has not seen it. First settle wins through the accepted
+  ledger's exactly-once retire — the loser resolves nothing, so the
+  no-silent-drop guarantee gains a no-double-resolve twin for free.
+- **Slow-replica probation** (``slow_detect=True`` /
+  ``DCNN_SLOW_DETECT``): per-replica completion latencies feed a
+  :class:`~dcnn_tpu.resilience.slowness.SlownessDetector`; a replica
+  convicted as a *sustained* relative outlier is demoted to probation
+  (sorts last in routing — traffic only when nothing healthier can
+  take it), and auto-rejoins after ``probation_cooldown_s`` once its
+  health probe passes clean, its score forgotten so fresh traffic
+  re-judges it (a still-slow replica re-convicts after the dwell). A
+  fleet-wide slowdown moves the median with everyone — nobody convicts.
+
 Chaos surface: ``serve.route`` trips in :meth:`Router.submit` (armed =
 routing-layer failure), ``serve.replica_infer`` in every replica
-dispatch, ``serve.swap`` in the version-load path
-(docs/reliability.md fault cookbook).
+dispatch, ``serve.swap`` in the version-load path, and the
+``serve.slow_replica`` delay point (``FaultPlan.slow``) stretches a
+replica's engine wall (docs/reliability.md fault cookbook).
 """
 
 from __future__ import annotations
@@ -61,6 +84,8 @@ import numpy as np
 from ..obs import get_tracer
 from ..resilience import faults as _faults
 from ..resilience.retry import retry_call
+from ..resilience.slowness import SlownessConfig, SlownessDetector
+from ..utils.env import get_env
 from .batcher import DrainingError, QueueFullError
 from .metrics import PRIORITIES, RouterMetrics
 from .replica import DEATH_ERRORS, ReplicaDeadError, ReplicaError
@@ -90,7 +115,7 @@ class _Handle:
 
     __slots__ = ("name", "replica", "state", "outstanding", "completed",
                  "failed", "consecutive_failures", "ewma_ms", "canary",
-                 "last_seq", "auto_rejoin")
+                 "last_seq", "auto_rejoin", "probation", "probation_since")
 
     def __init__(self, name: str, replica):
         self.name = name
@@ -107,11 +132,16 @@ class _Handle:
         # (failure_eject_threshold): the sweep must not flap it back in
         # on the same health probe that was lying — rejoin is explicit
         self.auto_rejoin = True
+        # latency probation (gray failure): still "up" but sorts last in
+        # routing until the cooldown elapses and a probe passes clean
+        self.probation = False
+        self.probation_since = 0.0
 
 
 class _Request:
     __slots__ = ("x", "n", "priority", "future", "t_submit", "attempts",
-                 "tried", "span")
+                 "tried", "span", "hedged", "dispatched", "hedge_names",
+                 "inflight")
 
     def __init__(self, x, n, priority, t_submit):
         self.x, self.n, self.priority = x, n, priority
@@ -123,6 +153,11 @@ class _Request:
         # hop runs under its context, so one request is ONE trace across
         # the fleet (null handle when tracing is off)
         self.span = None
+        # hedging state (check_hedges), mutated under the router lock:
+        self.hedged = False          # a duplicate was already launched
+        self.dispatched: set = set()  # every replica ever holding this
+        self.hedge_names: set = set()  # the duplicates' replicas
+        self.inflight = 0            # live dispatches; >0 blocks readmit
 
 
 class Router:
@@ -141,7 +176,13 @@ class Router:
                  metrics: Optional[RouterMetrics] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 name: str = "router", flight=None):
+                 name: str = "router", flight=None,
+                 hedge: Optional[bool] = None,
+                 hedge_multiplier: Optional[float] = None,
+                 hedge_min_s: Optional[float] = None,
+                 slow_detect: Optional[bool] = None,
+                 slow_config: Optional[SlownessConfig] = None,
+                 probation_cooldown_s: Optional[float] = None):
         self.name = name
         self.shares = dict(DEFAULT_SHARES if shares is None else shares)
         unknown = set(self.shares) - set(PRIORITIES)
@@ -158,6 +199,22 @@ class Router:
         self.failure_eject_threshold = failure_eject_threshold
         self.metrics = metrics if metrics is not None else RouterMetrics(
             clock=clock)
+        # gray-failure serving knobs (module docstring): None = read the
+        # env so a deployed router is switchable without a code change
+        self.hedge = bool(get_env("DCNN_HEDGE", False)
+                          if hedge is None else hedge)
+        self.hedge_multiplier = float(
+            get_env("DCNN_HEDGE_MULT", 3.0)
+            if hedge_multiplier is None else hedge_multiplier)
+        self.hedge_min_s = float(get_env("DCNN_HEDGE_MIN_S", 0.01)
+                                 if hedge_min_s is None else hedge_min_s)
+        self.slow_detect = bool(get_env("DCNN_SLOW_DETECT", False)
+                                if slow_detect is None else slow_detect)
+        self.probation_cooldown_s = float(
+            get_env("DCNN_SLOW_PROBATION_S", 5.0)
+            if probation_cooldown_s is None else probation_cooldown_s)
+        self.slowness = SlownessDetector(SlownessConfig.from_env(slow_config),
+                                         clock=clock)
         self._clock = clock
         self._sleep = sleep
         self._lock = threading.Lock()
@@ -292,6 +349,8 @@ class Router:
         m.outstanding_rows.set(self._outstanding)
         m.canary_replicas.set(
             sum(1 for h in self._handles.values() if h.canary))
+        m.probation_replicas.set(
+            sum(1 for h in self._handles.values() if h.probation))
 
     # -- admission + dispatch ----------------------------------------------
     def submit(self, x, priority: str = "normal") -> Future:
@@ -366,24 +425,26 @@ class Router:
                 return tuple(shp)
         return None
 
-    def _pick(self, req: _Request) -> Optional[_Handle]:
-        """Least-outstanding routable replica not yet tried for this
-        admission. Ties break on the completion-latency EWMA quantized to
-        ~30% log buckets (meaningfully slower replicas get less traffic;
-        noise-level differences do not starve anyone), then on
+    def _pick(self, exclude: set) -> Optional[_Handle]:
+        """Least-outstanding routable replica not in ``exclude``.
+        Probation replicas sort last outright (they take traffic only
+        when nothing healthier can). Remaining ties break on the
+        completion-latency EWMA quantized to ~30% log buckets
+        (meaningfully slower replicas get less traffic; noise-level
+        differences do not starve anyone), then on
         least-recently-dispatched — so an idle fleet round-robins instead
         of pinning everything to whichever replica happens to sort
         first."""
         with self._lock:
             candidates = [h for h in self._handles.values()
-                          if h.state == "up" and h.name not in req.tried]
+                          if h.state == "up" and h.name not in exclude]
             if not candidates:
                 return None
 
             def score(h: _Handle):
                 lat = (int(math.log(h.ewma_ms) * 4.0)
                        if h.ewma_ms is not None and h.ewma_ms > 0 else 0)
-                return (h.outstanding, lat, h.last_seq)
+                return (h.probation, h.outstanding, lat, h.last_seq)
 
             best = min(candidates, key=score)
             self._seq += 1
@@ -394,7 +455,7 @@ class Router:
         """One dispatch attempt: pick, submit, register the settle
         callback. Raises the replica's typed rejection for the retry
         wrapper to classify."""
-        h = self._pick(req)
+        h = self._pick(req.tried)
         if h is None:
             with self._lock:
                 fleet = {n: hh.state for n, hh in self._handles.items()}
@@ -412,6 +473,8 @@ class Router:
             raise
         with self._lock:
             h.outstanding += req.n
+            req.inflight += 1
+            req.dispatched.add(h.name)
         inner.add_done_callback(lambda f, h=h: self._settle(req, h, f))
 
     def _first_dispatch(self, req: _Request) -> None:
@@ -509,6 +572,7 @@ class Router:
             exc = inner.exception()
         with self._lock:
             h.outstanding = max(h.outstanding - req.n, 0)
+            req.inflight = max(req.inflight - 1, 0)
         if exc is None:
             t_done = self._clock()
             lat_ms = (t_done - req.t_submit) * 1e3
@@ -517,8 +581,15 @@ class Router:
                 h.consecutive_failures = 0
                 h.ewma_ms = (lat_ms if h.ewma_ms is None
                              else 0.8 * h.ewma_ms + 0.2 * lat_ms)
-            self._resolve_ok(req, inner.result(),
-                             latency_s=t_done - req.t_submit)
+            if self.slow_detect:
+                # probation signal: admit-to-complete wall attributed to
+                # the replica that served it (the losing half of a hedged
+                # pair lands here too — correctly, with its big latency)
+                self.slowness.observe(h.name, lat_ms)
+            won = self._resolve_ok(req, inner.result(),
+                                   latency_s=t_done - req.t_submit)
+            if won and h.name in req.hedge_names:
+                self.metrics.record_hedge_win()
             return
         # replica-attributed failure: count it, maybe eject, re-admit
         self.metrics.record_replica_error()
@@ -546,6 +617,13 @@ class Router:
             if self._retire(req):
                 get_tracer().end(req.span, outcome="cancelled")
             return
+        with self._lock:
+            still_inflight = req.inflight > 0
+        if still_inflight:
+            # a hedge (or, if the hedge just failed, the primary) still
+            # holds a live dispatch for this request — it owns settlement
+            # now; re-admitting here would triple-dispatch the request
+            return
         if closing or req.attempts >= self.max_readmits:
             self._resolve_exc(req, exc if isinstance(exc, ReplicaError)
                               else ReplicaDeadError(
@@ -569,9 +647,12 @@ class Router:
             return True
 
     def _resolve_ok(self, req: _Request, result,
-                    latency_s: float) -> None:
+                    latency_s: float) -> bool:
+        """True iff THIS call retired the request — the hedging dedupe:
+        the first settle of a hedged pair wins the ledger, the loser
+        resolves nothing (and must not count a hedge win)."""
         if not self._retire(req):
-            return
+            return False
         get_tracer().end(req.span, outcome="ok",
                          latency_ms=round(latency_s * 1e3, 3))
         try:
@@ -579,6 +660,7 @@ class Router:
             self.metrics.record_done(req.priority, latency_s, req.n)
         except InvalidStateError:
             pass  # cancelled by the caller while in flight
+        return True
 
     def _resolve_exc(self, req: _Request, exc: BaseException) -> None:
         if not self._retire(req):
@@ -600,7 +682,10 @@ class Router:
             if h.state == "dead":
                 return
             h.state = "dead"
+            h.probation = False  # death supersedes latency probation
             self._update_gauges_locked()
+        # a corpse's latency score must not keep shifting the fleet median
+        self.slowness.forget(h.name)
         self.metrics.record_replica_death()
         # postmortem evidence AT the death edge (once per ejection — the
         # guard above makes this edge-triggered): recent spans hold the
@@ -612,6 +697,127 @@ class Router:
             registry=self.metrics.registry,
             extra={"replica": h.name, "router": self.name,
                    "fleet": self.replica_stats()})
+
+    # -- gray failure: hedging + probation (module docstring; ISSUE 19) --
+    def _hedge_delay_s(self) -> Optional[float]:
+        """p99-derived hedge trigger: ``hedge_multiplier`` × the exact
+        fleet-wide windowed p99, floored at ``hedge_min_s``; ``None``
+        (no hedging) until enough completions exist to make the p99
+        meaningful."""
+        p99 = self.metrics.p99_ms()
+        if p99 is None:
+            return None
+        return max(self.hedge_min_s, self.hedge_multiplier * p99 / 1e3)
+
+    def check_hedges(self) -> int:
+        """Tail-latency hedging sweep ("The Tail at Scale"): every
+        accepted request with exactly one live dispatch older than the
+        hedge delay gets a duplicate on a replica that has not seen it.
+        First settle wins through the ledger's exactly-once retire; the
+        loser resolves nothing. Runs from :meth:`check_replicas` (and by
+        hand in tests/tight loops). Returns hedges launched."""
+        if not self.hedge:
+            return 0
+        delay = self._hedge_delay_s()
+        if delay is None:
+            return 0
+        now = self._clock()
+        with self._lock:
+            due = [req for req in self._ledger
+                   if not req.hedged and req.inflight == 1
+                   and now - req.t_submit >= delay
+                   and not req.future.done()]
+            for req in due:
+                req.hedged = True  # claimed under the lock: a racing
+                #                    sweep cannot double-hedge
+        launched = 0
+        for req in due:
+            if self._hedge_one(req):
+                launched += 1
+        return launched
+
+    def _hedge_one(self, req: _Request) -> bool:
+        """Dispatch the duplicate. Mirrors ``_try_replica`` but never
+        escalates: a hedge that cannot place (no untried routable
+        replica, or its submit sheds) is simply dropped — the primary
+        still owns the request, and hedging is strictly opportunistic
+        extra load, never extra failure."""
+        with self._lock:
+            exclude = set(req.dispatched)
+        h = self._pick(exclude)
+        if h is None:
+            return False
+        try:
+            with get_tracer().activate(req.span):
+                inner = h.replica.submit(req.x)
+        except DEATH_ERRORS as e:
+            self._note_dead(h, f"hedge submit failed: {e}")
+            return False
+        except Exception:
+            return False
+        with self._lock:
+            h.outstanding += req.n
+            req.inflight += 1
+            req.dispatched.add(h.name)
+            req.hedge_names.add(h.name)
+        self.metrics.record_hedge()
+        inner.add_done_callback(lambda f, h=h: self._settle(req, h, f))
+        return True
+
+    def check_probation(self) -> List[str]:
+        """Slow-replica probation sweep: steps the latency slowness
+        detector; a replica convicted as a *sustained* relative outlier
+        (probation → convict with dwell, docs/reliability.md §11) is
+        demoted — still "up", but it sorts last in routing. Release
+        needs the cooldown to elapse AND a clean health probe (the
+        eject/rejoin plumbing's probe); the released replica's score is
+        forgotten so fresh traffic re-judges it from scratch — a
+        still-slow replica re-convicts after the dwell. Returns the
+        names currently held in probation."""
+        if not self.slow_detect:
+            return []
+        now = self._clock()
+        for tr in self.slowness.evaluate():
+            if tr["to"] != "convicted":
+                continue
+            with self._lock:
+                h = self._handles.get(str(tr["component"]))
+                if h is None or h.probation:
+                    continue
+                h.probation = True
+                h.probation_since = now
+                self._update_gauges_locked()
+            self.metrics.record_probation()
+            self._flight_recorder().record(
+                "replica_probation",
+                reasons=[f"replica {tr['component']} latency EWMA "
+                         f"{tr['ewma']:.2f}ms vs fleet median "
+                         f"{tr['median']:.2f}ms — sustained outlier"],
+                config={"cooldown_s": self.probation_cooldown_s},
+                registry=self.metrics.registry,
+                extra={"router": self.name,
+                       "slowness": self.slowness.snapshot(),
+                       "fleet": self.replica_stats()})
+        with self._lock:
+            held = [h for h in self._handles.values() if h.probation]
+        still: List[str] = []
+        for h in held:
+            release = now - h.probation_since >= self.probation_cooldown_s
+            if release:
+                try:
+                    release = (h.replica.health() is None
+                               and not h.replica.is_dead())
+                except Exception:
+                    release = False
+            if release:
+                with self._lock:
+                    h.probation = False
+                    self._update_gauges_locked()
+                self.slowness.forget(h.name)
+                self.metrics.record_probation_rejoin()
+            else:
+                still.append(h.name)
+        return still
 
     def check_replicas(self) -> Dict[str, Any]:
         """One liveness sweep — the router's heartbeat, called by the
@@ -689,6 +895,12 @@ class Router:
                         h.state = "up"
                         self._update_gauges_locked()
                 report[h.name] = "up"
+        # the gray-failure sweeps ride the same heartbeat: probation
+        # verdicts step first (so a convicted replica stops catching
+        # hedges), then overdue tail requests hedge out
+        for name in self.check_probation():
+            report[name] = f"{report.get(name, 'up')} (probation)"
+        self.check_hedges()
         return report
 
     def rejoin(self, name: str) -> None:
@@ -776,6 +988,7 @@ class Router:
                 "failed": h.failed,
                 "consecutive_failures": h.consecutive_failures,
                 "ewma_ms": h.ewma_ms,
+                "probation": h.probation,
             } for h in self._handles.values()}
 
     # -- health / telemetry ------------------------------------------------

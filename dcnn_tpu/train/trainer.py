@@ -695,6 +695,7 @@ class Trainer:
                 from ..obs.anomaly import AnomalyMonitor
                 from ..obs.goodput import GoodputMonitor
                 from ..obs.rules import (RuleEngine, goodput_alert_rules,
+                                         gray_failure_alert_rules,
                                          rules_check)
                 self._goodput = GoodputMonitor(
                     tracer=tracer, registry=reg, store=store,
@@ -708,6 +709,8 @@ class Trainer:
                 self._tsdb.add_after_sample(self._goodput.poll)
                 engine = RuleEngine(store, registry=reg)
                 for rule in goodput_alert_rules():
+                    engine.add_alert(rule)
+                for rule in gray_failure_alert_rules():
                     engine.add_alert(rule)
                 self._tsdb.add_after_sample(lambda s: engine.evaluate())
                 srv.add_check("alerts", rules_check(engine))

@@ -1,0 +1,885 @@
+"""Fail-slow (gray-failure) tolerance (ISSUE 19).
+
+The shared :class:`SlownessDetector` contract under fake clocks, the
+``FaultPlan.slow`` delay-injection twin of ``arm``, and the three
+mitigation surfaces end to end:
+
+- **elastic DP straggler eviction** — a 3-peer in-process fleet with one
+  peer armed slow at ``elastic.slow_peer``: the leader convicts and
+  evicts it through the generation-fenced reconfiguration, and the
+  survivors' final params match the uninterrupted fixed-world run within
+  the PR-8 reshard tolerance. A fleet-wide slowdown convicts nobody.
+- **pipeline stage rebalance** — a 3-stage TCP pipeline with one stage
+  armed slow at ``pipeline.slow_stage``: the coordinator repartitions
+  layer ranges proportional to measured walls (rebalance, never evict)
+  and training lands on the uninterrupted run's params.
+- **router hedging + slow-replica probation** — fully fake-clock,
+  sleep-free: the hedge fires after the p99-derived delay, the ledger's
+  exactly-once retire dedupes the pair (the late loser resolves
+  nothing), a hedged request whose primary fails is NOT re-admitted
+  while the hedge is live, and a convicted replica is demoted to
+  probation then auto-rejoined after the cooldown + clean probe.
+- **feed-worker recycle** — a convicted slow worker (armed at
+  ``feed.slow_worker``) is retired through the worker-death fallback
+  with bit-identical output.
+"""
+
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from dcnn_tpu.resilience.faults import (
+    FaultPlan, InjectedFault, clear, install, slowdown,
+)
+from dcnn_tpu.resilience.slowness import SlownessConfig, SlownessDetector
+
+RTOL, ATOL = 2e-4, 2e-5  # PR-8 reshard tolerance: FP reassociation only
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# SlownessConfig validation + env plumbing
+# ---------------------------------------------------------------------------
+
+def test_slowness_config_validation():
+    with pytest.raises(ValueError, match="min_peers"):
+        SlownessConfig(min_peers=1)
+    with pytest.raises(ValueError, match="ratio must be > 1"):
+        SlownessConfig(ratio=1.0)
+    with pytest.raises(ValueError, match="exit_ratio"):
+        SlownessConfig(ratio=2.0, exit_ratio=2.5)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        SlownessConfig(ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="dwell_s"):
+        SlownessConfig(dwell_s=-0.1)
+    with pytest.raises(ValueError, match="min_samples"):
+        SlownessConfig(min_samples=0)
+
+
+def test_slowness_config_from_env(monkeypatch):
+    monkeypatch.setenv("DCNN_SLOW_RATIO", "3.5")
+    monkeypatch.setenv("DCNN_SLOW_MIN_PEERS", "4")
+    cfg = SlownessConfig.from_env(SlownessConfig(dwell_s=0.7))
+    assert cfg.ratio == 3.5
+    assert cfg.min_peers == 4
+    assert cfg.dwell_s == 0.7          # base fields survive the overlay
+    assert cfg.mad_k == 4.0            # untouched default
+
+
+# ---------------------------------------------------------------------------
+# detector state machine (fake clock, sleep-free)
+# ---------------------------------------------------------------------------
+
+def _det(fc, **kw):
+    kw.setdefault("ewma_alpha", 1.0)   # score == last sample: exact tests
+    kw.setdefault("min_samples", 1)
+    kw.setdefault("dwell_s", 5.0)
+    return SlownessDetector(SlownessConfig(**kw), clock=fc)
+
+
+def _feed(det, walls):
+    for c, w in walls.items():
+        det.observe(c, w)
+
+
+def test_outlier_convicts_only_after_dwell():
+    fc = FakeClock()
+    det = _det(fc)
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 1.0, "d": 10.0})
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("d", "probation")]
+    assert trs[0]["median"] == 1.0
+    fc.advance(4.9)                    # inside the dwell: one GC pause
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 1.0, "d": 10.0})
+    assert det.evaluate() == []
+    assert det.state("d") == "probation"
+    fc.advance(0.2)                    # sustained past dwell_s
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("d", "convicted")]
+    assert det.convicted() == ["d"]
+    # recovery: below the exit band -> healthy again
+    det.observe("d", 1.4)              # <= exit_ratio(1.5) * median(1.0)
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("d", "healthy")]
+
+
+def test_exit_hysteresis_band_does_not_flap():
+    """Between ``exit_ratio*median`` and the entry threshold, a component
+    neither clears nor re-enters — the band gap is the flap filter, and
+    the original probation stamp keeps the dwell clock honest."""
+    fc = FakeClock()
+    det = _det(fc)
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 1.0, "d": 10.0})
+    det.evaluate()                     # d -> probation at t=0
+    fc.advance(3.0)
+    det.observe("d", 1.8)              # in the band: 1.5 < 1.8 < 2.0
+    assert det.evaluate() == []        # no transition either way
+    assert det.state("d") == "probation"
+    fc.advance(3.0)                    # 6 s since entry: dwell elapsed
+    det.observe("d", 10.0)             # outlier again
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("d", "convicted")]
+
+
+def test_fleet_wide_slowdown_convicts_nobody():
+    """THE hard rule: everyone slow together moves the median with them
+    — no outlier, no verdict (the input got bigger, nobody gray-failed)."""
+    fc = FakeClock()
+    det = _det(fc)
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 1.1, "d": 0.9})
+    assert det.evaluate() == []
+    for _ in range(5):
+        fc.advance(10.0)               # far past any dwell
+        _feed(det, {"a": 10.0, "b": 10.0, "c": 11.0, "d": 9.0})
+        assert det.evaluate() == []
+    assert set(det.states().values()) == {"healthy"}
+
+
+def test_below_min_peers_nobody_judged_and_probation_unflags():
+    fc = FakeClock()
+    det = _det(fc, min_peers=3)
+    _feed(det, {"a": 1.0, "b": 100.0})
+    assert det.evaluate() == []        # 2 scored < min_peers: no median
+    assert det.state("b") == "healthy"
+    # grow the fleet -> b becomes a judged outlier
+    det.observe("c", 1.0)
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("b", "probation")]
+    # shrink it again (eviction elsewhere): probation un-flags — the
+    # fleet b was an outlier of no longer exists
+    det.forget("c")
+    trs = det.evaluate()
+    assert [(t["component"], t["to"]) for t in trs] == [("b", "healthy")]
+
+
+def test_min_samples_gates_scoring():
+    fc = FakeClock()
+    det = _det(fc, min_samples=3)
+    for _ in range(2):
+        _feed(det, {"a": 1.0, "b": 1.0, "c": 50.0})
+    assert det.fleet_median() is None  # nobody has 3 samples yet
+    assert det.evaluate() == []
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 50.0})
+    assert det.fleet_median() == 1.0
+    assert [t["to"] for t in det.evaluate()] == ["probation"]
+
+
+def test_probe_ok_excludes_probed_component_and_fails_open():
+    fc = FakeClock()
+    det = _det(fc)
+    _feed(det, {"a": 1.0, "b": 1.0, "c": 1.0})
+    assert det.probe_ok("d", 1.2)      # <= exit_ratio * median
+    assert not det.probe_ok("d", 2.0)
+    # the probed component's own (stale, huge) score must not judge it
+    det.observe("d", 50.0)
+    assert det.probe_ok("d", 1.2)
+    # no fleet to compare against: fail open, like the min_peers rule
+    lone = _det(FakeClock())
+    _feed(lone, {"a": 1.0})
+    assert lone.probe_ok("a", 100.0)
+
+
+def test_observe_ignores_negative_walls_and_snapshot_shape():
+    fc = FakeClock()
+    det = _det(fc)
+    det.observe("a", -1.0)             # clock-skew artifact
+    assert det.fleet_median() is None
+    _feed(det, {"a": 2.0, "b": 2.0, "c": 4.0})
+    snap = det.snapshot()
+    assert snap["c"]["ratio_to_median"] == pytest.approx(2.0)
+    assert snap["a"]["state"] == "healthy"
+    assert snap["a"]["samples"] == 1
+    det.forget("a")
+    assert "a" not in det.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan.slow — the delay-injection twin of arm()
+# ---------------------------------------------------------------------------
+
+def test_faultplan_slow_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        FaultPlan().slow("p")
+    with pytest.raises(ValueError, match="exactly one"):
+        FaultPlan().slow("p", factor=2.0, delay_s=1.0)
+    with pytest.raises(ValueError, match="factor"):
+        FaultPlan().slow("p", factor=0.5)
+    with pytest.raises(ValueError, match="delay_s"):
+        FaultPlan().slow("p", delay_s=-1.0)
+
+
+def test_faultplan_slow_factor_and_delay():
+    plan = FaultPlan().slow("p", factor=3.0)
+    assert plan.slowdown("p", 2.0) == pytest.approx(4.0)  # base*(f-1)
+    plan.unslow("p")
+    assert plan.slowdown("p", 2.0) == 0.0
+    plan.slow("p", delay_s=0.5)
+    assert plan.slowdown("p", 100.0) == pytest.approx(0.5)  # fixed stall
+    assert plan.slowdown("other", 1.0) == 0.0
+
+
+def test_faultplan_slow_at_times_window():
+    plan = FaultPlan().slow("p", delay_s=1.0, at=1, times=2)
+    got = [plan.slowdown("p") for _ in range(4)]
+    assert got == [0.0, 1.0, 1.0, 0.0]  # fires at invocations 1 and 2
+    assert plan.slow_count("p") == 4    # every query counted
+
+
+def test_module_global_slowdown_hook():
+    plan = FaultPlan().slow("p", delay_s=0.25)
+    assert slowdown("p", 1.0) == 0.0    # nothing installed
+    install(plan)
+    try:
+        assert slowdown("p", 1.0) == pytest.approx(0.25)
+    finally:
+        clear()
+    assert slowdown("p", 1.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# elastic DP: straggler eviction (in-process fleet over loopback)
+# ---------------------------------------------------------------------------
+
+_rng = np.random.default_rng(0)
+EX = _rng.normal(size=(48, 16)).astype(np.float32)
+BATCH = 12
+
+
+def _e_model():
+    from dcnn_tpu.nn import SequentialBuilder
+    return (SequentialBuilder("slow_elastic").input((16,))
+            .dense(32).activation("relu").dense(4).build())
+
+
+def _e_loader():
+    from dcnn_tpu.data.loader import ArrayDataLoader, one_hot
+    ey = one_hot(np.random.default_rng(1).integers(0, 4, 48), 4)
+    return ArrayDataLoader(EX, ey, batch_size=BATCH, seed=7)
+
+
+def _run_elastic(n, *, epochs=4, faults=None, ckpt_dir=None, slow=False,
+                 join_ranks=None):
+    """N in-process elastic peers over loopback; joins ``join_ranks``
+    (default all) and returns (controllers, results, threads)."""
+    from dcnn_tpu.core.config import TrainingConfig
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel import comm
+    from dcnn_tpu.parallel.elastic import ElasticController, PeerSpec
+
+    faults = faults or {}
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(n)]
+    peers = [PeerSpec(i, "127.0.0.1", s.getsockname()[1])
+             for i, s in enumerate(socks)]
+    ctls, results = {}, {}
+
+    def runner(i):
+        cfg = TrainingConfig(
+            epochs=epochs, learning_rate=0.05, seed=3, snapshot_dir=None,
+            elastic=True, elastic_microbatches=6, elastic_timeout_s=20.0,
+            elastic_heartbeat_s=0.0, elastic_ckpt_steps=2,
+            checkpoint_dir=ckpt_dir, slow_detect=slow, slow_dwell_s=0.2,
+            slow_min_samples=2)
+        ctl = ElasticController(
+            _e_model(), SGD(0.05), "softmax_crossentropy", _e_loader(),
+            config=cfg, rank=i, peers=peers, listen_sock=socks[i],
+            fault_plan=faults.get(i))
+        ctls[i] = ctl
+        try:
+            results[i] = ctl.fit(epochs=epochs)
+        except Exception as e:  # surfaced to the asserting test
+            results[i] = e
+
+    threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for i in (join_ranks if join_ranks is not None else range(n)):
+        threads[i].join(timeout=180)
+        assert not threads[i].is_alive(), f"elastic rank {i} hung"
+    return ctls, results, threads
+
+
+def _leaves(ts):
+    import jax
+    return jax.tree_util.tree_leaves(jax.device_get(ts.params))
+
+
+@pytest.fixture(scope="module")
+def elastic_baseline3():
+    """Never-interrupted fixed-world run: 3 peers, K=6, detector off."""
+    _ctls, results, _ = _run_elastic(3)
+    return _leaves(results[0])
+
+
+def test_slow_peer_convicted_and_evicted_params_match(elastic_baseline3):
+    """ACCEPTANCE: rank 2 armed ``elastic.slow_peer`` (a fixed 50 ms
+    stall per step — a thermally-throttled host). The leader convicts it
+    as a sustained relative outlier, evicts it through the normal
+    generation-fenced reconfiguration, and the 2 survivors finish with
+    params matching the uninterrupted 3-peer run."""
+    victim_plan = FaultPlan().slow("elastic.slow_peer", delay_s=0.05)
+    with tempfile.TemporaryDirectory() as d:
+        ctls, results, _ = _run_elastic(
+            3, faults={2: victim_plan}, ckpt_dir=d, slow=True,
+            join_ranks=[0, 1])  # the evictee may linger on its timeout
+    leader = ctls[0]
+    for r in (0, 1):
+        assert not isinstance(results[r], BaseException), results[r]
+    # the injection really ran on the victim's step loop
+    assert victim_plan.slow_count("elastic.slow_peer") > 0
+    # conviction: exactly one straggler eviction, world 3 -> 2
+    assert leader.stats["stragglers_evicted"] == 1
+    assert leader.world == 2 and leader.gen >= 1
+    assert sorted(leader.survivors) == [0, 1]
+    # the global batch stayed exact across the reshard
+    assert {e["global_rows"] for e in leader.step_log} == {BATCH}
+    # survivors bit-identical to each other, close to the baseline
+    for a, b in zip(_leaves(results[0]), _leaves(results[1])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(elastic_baseline3, _leaves(results[0])):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=ATOL)
+
+
+def test_fleet_wide_slowdown_evicts_nobody_elastic():
+    """Every peer armed with the same slowdown: the median moves with
+    the fleet, nobody is an outlier, training completes at world 3 with
+    zero evictions — the detector's hard rule, end to end."""
+    plans = {i: FaultPlan().slow("elastic.slow_peer", factor=2.5)
+             for i in range(3)}
+    ctls, results, _ = _run_elastic(3, epochs=2, faults=plans, slow=True)
+    for i in range(3):
+        assert not isinstance(results[i], BaseException), results[i]
+        assert ctls[i].stats["stragglers_evicted"] == 0
+        assert ctls[i].world == 3 and ctls[i].gen == 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline: measured repartition (rebalance, never evict)
+# ---------------------------------------------------------------------------
+
+def _p_model():
+    from dcnn_tpu.nn import SequentialBuilder
+    b = SequentialBuilder("slow_pipe").input((16,))
+    for _ in range(6):
+        b = b.dense(16)
+    return b.dense(4).build()
+
+
+def test_measured_partitioner_sheds_layers_off_slow_stage():
+    from dcnn_tpu.parallel.partitioner import (
+        FlopBalancedPartitioner, MeasuredPartitioner, NaivePartitioner)
+
+    model = _p_model()                  # 7 layers
+    naive = NaivePartitioner().get_partitions(model, 3)
+    part = MeasuredPartitioner(naive, [1.0, 30.0, 1.0])
+    new = part.get_partitions(model, 3)
+    assert new != naive
+    # the slow stage sheds layers in proportion to its measured wall
+    old_mid = naive[1][1] - naive[1][0]
+    new_mid = new[1][1] - new[1][0]
+    assert new_mid < old_mid
+    # ranges still tile the model exactly
+    assert new[0][0] == 0 and new[-1][1] == len(model.layers)
+    for (_, e), (s, _) in zip(new, new[1:]):
+        assert e == s
+    # no measurements -> degrades to the FLOP-balanced walk
+    flat = MeasuredPartitioner(naive, [0.0, 0.0, 0.0])
+    assert flat.get_partitions(model, 3) == \
+        FlopBalancedPartitioner().get_partitions(model, 3)
+    with pytest.raises(ValueError, match="partitions vs"):
+        MeasuredPartitioner(naive, [1.0, 2.0])
+
+
+def _pipe_batches(n=8, rows=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(size=(rows, 16)).astype(np.float32),
+             np.eye(4, dtype=np.float32)[rng.integers(0, 4, rows)])
+            for _ in range(n)]
+
+
+def _pipe_fleet(n=3, plans=None):
+    from dcnn_tpu.parallel import StageWorker, comm
+    from dcnn_tpu.resilience.faults import InjectedCrash
+
+    socks = [comm.listen(0, host="127.0.0.1") for _ in range(n)]
+    addrs = [f"127.0.0.1:{s.getsockname()[1]}" for s in socks]
+    plans = plans or [FaultPlan() for _ in range(n)]
+    workers = [StageWorker(0, listen_sock=s, fault_plan=p)
+               for s, p in zip(socks, plans)]
+
+    def serve(w):
+        try:
+            w.serve()
+        except InjectedCrash:
+            pass
+
+    threads = [threading.Thread(target=serve, args=(w,), daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+
+    def close():
+        for w in workers:
+            w.stop()
+        for t in threads:
+            t.join(timeout=10)
+
+    return addrs, close
+
+
+def _pipe_run(addrs, *, batches, rebalance=False, **kw):
+    import jax
+    from dcnn_tpu.optim import SGD
+    from dcnn_tpu.parallel import DistributedPipelineCoordinator
+
+    co = DistributedPipelineCoordinator(
+        _p_model(), SGD(0.05, momentum=0.9), "softmax_crossentropy",
+        workers=addrs, num_microbatches=2, timeout=60.0, **kw)
+    co.deploy_stages(jax.random.PRNGKey(0))
+    for b, (x, y) in enumerate(batches):
+        co.train_batch_sync(x, y, 0.05, jax.random.PRNGKey(b))
+        if rebalance:
+            co.maybe_rebalance()
+    params, state = co.gathered_params()
+    co.shutdown()
+    return co, jax.device_get(params)
+
+
+def test_slow_stage_triggers_measured_rebalance(tmp_path):
+    """ACCEPTANCE: stage 1 armed ``pipeline.slow_stage`` (50 ms per
+    fwd/bwd job — big enough to dominate the warm-up walls the
+    cumulative load averages carry). The between-batch sweep convicts it as a sustained
+    outlier and ships a measured repartition through the recovery
+    machinery — exact momentum, zero rewind: final params match the
+    uninterrupted run, zero batches lost, and the evidence (imbalance
+    gauge, counter, flight bundle) is all recorded."""
+    import jax
+    from dcnn_tpu.obs.flight import FlightRecorder
+    from dcnn_tpu.obs.registry import MetricsRegistry
+
+    batches = _pipe_batches(8)
+    # reference: same batches, no fault, no rebalance sweeps
+    addrs, close = _pipe_fleet(3)
+    try:
+        _co, ref_params = _pipe_run(addrs, batches=batches,
+                                    track_load=True)
+    finally:
+        close()
+
+    plans = [FaultPlan() for _ in range(3)]
+    plans[1].slow("pipeline.slow_stage", delay_s=0.05)
+    reg = MetricsRegistry()
+    flight = FlightRecorder(str(tmp_path / "flight"))
+    addrs, close = _pipe_fleet(3, plans)
+    try:
+        co, params = _pipe_run(
+            addrs, batches=batches, rebalance=True, track_load=True,
+            registry=reg, flight=flight,
+            slow_config=SlownessConfig(min_peers=2, min_samples=2,
+                                       dwell_s=0.05))
+    finally:
+        close()
+
+    assert plans[1].slow_count("pipeline.slow_stage") > 0
+    assert co.stats["rebalances"] >= 1
+    assert co.stats["batches_lost"] == 0
+    snap = reg.snapshot()
+    assert snap["pipeline_rebalances_total"] == co.stats["rebalances"]
+    assert snap["pipeline_stage_imbalance"] > 1.5  # the outlier was real
+    assert any(b["trigger"] == "pipeline_rebalance"
+               for b in flight.bundles())
+    # rebalance preserved the training trajectory exactly
+    for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=RTOL, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# router: hedged requests + slow-replica probation (fake clock)
+# ---------------------------------------------------------------------------
+
+class FakeEngine:
+    """Batcher-compatible engine without jax: logits = x + 1."""
+
+    input_shape = (4,)
+    max_batch = 8
+    bucket_sizes = [1, 2, 4, 8]
+    version = 1
+    batch_invariant = True
+
+    def bucket_for(self, n):
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        raise ValueError(n)
+
+    def pad_to_bucket(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if b > n:
+            x = np.concatenate([x, np.zeros((b - n, 4), np.float32)])
+        return x, n
+
+    def run_padded(self, x):
+        return np.asarray(x, np.float32) + 1.0
+
+
+def _router_fleet(n=3, **kw):
+    from dcnn_tpu.serve import LocalReplica, Router
+
+    fc = FakeClock()
+    plans, reps = {}, []
+    for i in range(n):
+        plans[f"r{i}"] = FaultPlan()
+        reps.append(LocalReplica(
+            FakeEngine(), name=f"r{i}", queue_capacity=64, clock=fc,
+            fault_plan=plans[f"r{i}"], start=False))
+    router = Router(reps, clock=fc, sleep=lambda s: fc.advance(s), **kw)
+    return router, reps, plans, fc
+
+
+def _pump(reps, rounds=4):
+    for _ in range(rounds):
+        for r in reps:
+            while r.step():
+                pass
+
+
+def _prime_p99(router, reps, fc, n=20, lat=0.01):
+    """Feed the windowed p99 so the hedge delay resolves (floored at
+    hedge_min_s here: 3 * 10 ms < 50 ms)."""
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(n)]
+    fc.advance(lat)
+    _pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+
+
+def _one_outstanding(router, exclude=()):
+    return [name for name, st in router.replica_stats().items()
+            if st["outstanding"] > 0 and name not in exclude]
+
+
+def test_serve_slow_replica_point_is_on_the_dispatch_path():
+    """The ``serve.slow_replica`` delay hook wraps the engine dispatch —
+    armed with a zero stall it must still be queried per batch."""
+    router, reps, plans, _ = _router_fleet(1)
+    plans["r0"].slow("serve.slow_replica", delay_s=0.0)
+    f = router.submit(np.zeros(4, np.float32))
+    _pump(reps)
+    assert f.exception(timeout=0) is None
+    assert plans["r0"].slow_count("serve.slow_replica") >= 1
+
+
+def test_hedge_fires_after_delay_and_loser_resolves_nothing():
+    """ACCEPTANCE (hedging dedupe): the duplicate launches only past the
+    p99-derived delay; the first settle wins the ledger exactly once; the
+    late loser resolves nothing — no silent drop AND no double-resolve."""
+    router, reps, _, fc = _router_fleet(3, hedge=True, hedge_min_s=0.05)
+    _prime_p99(router, reps, fc)
+    done_before = sum(
+        st["completed"] for st in router.replica_stats().values())
+
+    f = router.submit(np.zeros(4, np.float32))
+    primary = _one_outstanding(router)
+    assert len(primary) == 1
+    fc.advance(0.04)
+    assert router.check_hedges() == 0   # younger than the delay
+    fc.advance(0.02)
+    assert router.check_hedges() == 1   # one duplicate launched
+    assert router.check_hedges() == 0   # claimed: never double-hedged
+    hedge = _one_outstanding(router, exclude=primary)
+    assert len(hedge) == 1 and hedge != primary
+    by_name = {r.name: r for r in reps}
+    # the hedge settles first and wins ...
+    while by_name[hedge[0]].step():
+        pass
+    np.testing.assert_array_equal(f.result(timeout=0),
+                                  np.ones(4, np.float32))
+    assert router.outstanding() == 0    # retired exactly once
+    # ... the primary's late settle resolves nothing
+    while by_name[primary[0]].step():
+        pass
+    assert router.outstanding() == 0
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_hedges_total"] == 1
+    assert snap["serve_router_hedge_wins_total"] == 1
+    # router-level completion counted once despite two replica settles
+    done = sum(v for k, v in snap.items()
+               if k.startswith("serve_router_completed_"))
+    assert done == 21
+    assert sum(st["completed"]
+               for st in router.replica_stats().values()) == done_before + 2
+
+
+def test_hedged_request_not_readmitted_while_hedge_inflight():
+    """A hedged pair whose primary FAILS must not re-admit: the live
+    hedge owns settlement (re-admitting would triple-dispatch)."""
+    router, reps, plans, fc = _router_fleet(3, hedge=True, hedge_min_s=0.05)
+    _prime_p99(router, reps, fc)
+    f = router.submit(np.zeros(4, np.float32))
+    primary = _one_outstanding(router)[0]
+    fc.advance(0.06)
+    assert router.check_hedges() == 1
+    plans[primary].arm("serve.replica_infer", exc=InjectedFault, times=1)
+    by_name = {r.name: r for r in reps}
+    while by_name[primary].step():   # primary fails first
+        pass
+    assert not f.done()              # the hedge still owns the request
+    assert router.metrics.registry.snapshot().get(
+        "serve_router_readmits_total", 0) == 0
+    _pump(reps)                      # the hedge settles it
+    assert f.exception(timeout=0) is None
+    assert router.outstanding() == 0
+
+
+def test_hedge_cancellation_safe():
+    router, reps, _, fc = _router_fleet(3, hedge=True, hedge_min_s=0.05)
+    _prime_p99(router, reps, fc)
+    f = router.submit(np.zeros(4, np.float32))
+    fc.advance(0.06)
+    assert router.check_hedges() == 1
+    assert f.cancel()
+    _pump(reps)                      # both settles find a resolved future
+    assert router.outstanding() == 0  # ledger swept, nothing leaked
+
+
+def test_hedge_with_no_spare_replica_is_opportunistic():
+    """A hedge that cannot place (single replica already holds the
+    request) is dropped silently — never extra failure."""
+    router, reps, _, fc = _router_fleet(1, hedge=True, hedge_min_s=0.05)
+    _prime_p99(router, reps, fc, n=20)
+    f = router.submit(np.zeros(4, np.float32))
+    fc.advance(0.06)
+    assert router.check_hedges() == 0
+    _pump(reps)
+    assert f.exception(timeout=0) is None
+    assert router.metrics.registry.snapshot()[
+        "serve_router_hedges_total"] == 0
+
+
+def test_hedging_off_until_p99_exists():
+    router, _, _, fc = _router_fleet(2, hedge=True, hedge_min_s=0.05)
+    router.submit(np.zeros(4, np.float32))
+    fc.advance(10.0)
+    assert router.check_hedges() == 0   # no p99 yet: hedging disarmed
+
+
+def _slow_round(router, reps, fc, slow="r0", fast_lat=0.01, slow_lat=1.0):
+    """One traffic round: every replica serves one request; ``slow``
+    answers after ``slow_lat`` on the shared fake clock."""
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(len(reps))]
+    fc.advance(fast_lat)
+    for r in reps:
+        if r.name != slow:
+            while r.step():
+                pass
+    fc.advance(slow_lat - fast_lat)
+    for r in reps:
+        if r.name == slow:
+            while r.step():
+                pass
+    assert all(f.exception(timeout=0) is None for f in futs)
+
+
+def test_slow_replica_probation_and_auto_rejoin():
+    """ACCEPTANCE (probation round-trip): a convicted latency outlier is
+    demoted (hard-sorted last in routing, still up), held for the
+    cooldown, then auto-rejoined on a clean probe with its score
+    forgotten — all on the fake clock, sleep-free."""
+    router, reps, _, fc = _router_fleet(
+        3, slow_detect=True, probation_cooldown_s=5.0,
+        slow_config=SlownessConfig(min_peers=3, min_samples=2,
+                                   dwell_s=0.5))
+    for _ in range(3):
+        _slow_round(router, reps, fc)
+        router.check_probation()
+    stats = router.replica_stats()
+    assert stats["r0"]["probation"] is True
+    assert stats["r0"]["state"] == "up"      # demoted, not ejected
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_probations_total"] == 1
+    assert snap["serve_router_probation_replicas"] == 1
+    # routing avoids the probation replica entirely: everything resolves
+    # with r0 never pumped
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(4)]
+    _pump([r for r in reps if r.name != "r0"])
+    assert all(f.exception(timeout=0) is None for f in futs)
+    assert router.replica_stats()["r0"]["outstanding"] == 0
+    # held while the cooldown runs ...
+    assert router.check_probation() == ["r0"]
+    # ... released after it, on a clean health probe, score forgotten
+    fc.advance(6.0)
+    assert router.check_probation() == []
+    stats = router.replica_stats()
+    assert stats["r0"]["probation"] is False
+    snap = router.metrics.registry.snapshot()
+    assert snap["serve_router_probation_rejoins_total"] == 1
+    assert snap["serve_router_probation_replicas"] == 0
+    assert router.slowness.state("r0") == "healthy"
+    # fresh traffic re-judges from scratch: r0 serves again
+    futs = [router.submit(np.zeros(4, np.float32)) for _ in range(3)]
+    _pump(reps)
+    assert all(f.exception(timeout=0) is None for f in futs)
+
+
+def test_probation_sweep_rides_check_replicas():
+    router, reps, _, fc = _router_fleet(
+        3, slow_detect=True, probation_cooldown_s=50.0,
+        slow_config=SlownessConfig(min_peers=3, min_samples=2,
+                                   dwell_s=0.5))
+    for _ in range(3):
+        _slow_round(router, reps, fc)
+        router.check_replicas()
+    report = router.check_replicas()
+    assert report["r0"] == "up (probation)"
+
+
+# ---------------------------------------------------------------------------
+# feed pool: slow-worker recycle through the worker-death fallback
+# ---------------------------------------------------------------------------
+
+def _feed_data(n=96):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=n).astype(np.int32)
+    return x, y
+
+
+def test_feed_slow_worker_point_inflates_walls_bit_identically():
+    """``feed.slow_worker`` stretches the reported prep wall INSIDE the
+    worker (a genuinely slow worker, not a lying fast one) and never
+    touches the output bytes."""
+    from dcnn_tpu.data.workers import FeedWorkerPool, serial_shards
+
+    x, y = _feed_data()
+    sels = [np.arange(i * 12, (i + 1) * 12) for i in range(4)]
+    ser = [(a.copy(), b.copy()) for a, b, _ in
+           serial_shards(x, y, sels, seed=5, epoch=1)]
+    plan = FaultPlan().slow("feed.slow_worker", delay_s=0.004)
+    install(plan)
+    try:
+        pool = FeedWorkerPool(x, y, 12, num_workers=2, backend="thread",
+                              seed=5, poll_s=0.02)
+        got, walls = [], []
+        for ps in pool.shards(iter(sels), epoch=1):
+            got.append((ps.x.copy(), ps.y.copy()))
+            walls.append(ps.stats["prep_s"])
+            ps.release()
+        pool.close()
+    finally:
+        clear()
+    assert plan.slow_count("feed.slow_worker") >= 1
+    assert max(walls) >= 0.004          # the stall is in the report
+    for (sx, sy), (gx, gy) in zip(ser, got):
+        np.testing.assert_array_equal(sx, gx)
+        np.testing.assert_array_equal(sy, gy)
+
+
+def test_convicted_slow_worker_recycled_bit_identically():
+    """A convicted worker is retired through the worker-death fallback:
+    it refuses its next claim and exits, its shard is produced inline,
+    the counter records it, and the epoch's bytes are untouched (shard
+    RNG never involves the worker id)."""
+    from dcnn_tpu.data.workers import FeedWorkerPool, serial_shards
+    from dcnn_tpu.obs.registry import MetricsRegistry
+
+    x, y = _feed_data()
+    sels = [np.arange(i * 12, (i + 1) * 12) for i in range(6)]
+    reg = MetricsRegistry()
+    pool = FeedWorkerPool(
+        x, y, 12, num_workers=3, backend="thread", seed=5, poll_s=0.02,
+        registry=reg, slow_detect=True,
+        slow_config=SlownessConfig(min_peers=2, min_samples=2,
+                                   dwell_s=0.0))
+    try:
+        # drive the recycler exactly as _pump does, with synthetic walls:
+        # w2 is a sustained 20x outlier, w0/w1 the healthy fleet
+        for _ in range(3):
+            pool._note_worker_wall(0, 0.001)
+            pool._note_worker_wall(1, 0.001)
+            pool._note_worker_wall(2, 0.02)
+        assert 2 in pool._retired
+        assert reg.snapshot()["feed_worker_recycled_total"] == 1
+        # the retired worker's score no longer shifts the fleet median
+        assert "w2" not in pool._slowness.snapshot()
+        # the epoch still lands, bit-identical to the serial reference
+        ser = [(a.copy(), b.copy()) for a, b, _ in
+               serial_shards(x, y, sels, seed=5, epoch=2)]
+        got, producers = [], []
+        for ps in pool.shards(iter(sels), epoch=2):
+            got.append((ps.x.copy(), ps.y.copy()))
+            producers.append(ps.stats.get("worker"))
+            ps.release()
+        for (sx, sy), (gx, gy) in zip(ser, got):
+            np.testing.assert_array_equal(sx, gx)
+            np.testing.assert_array_equal(sy, gy)
+        # the retired worker never produces again: any task it claims is
+        # refused and rescued inline (it may idle-block on an empty queue
+        # rather than exit, so assert on output, not thread liveness)
+        assert producers and 2 not in producers
+    finally:
+        pool.close()
+
+
+def test_last_producer_is_never_recycled():
+    from dcnn_tpu.data.workers import FeedWorkerPool
+    from dcnn_tpu.obs.registry import MetricsRegistry
+
+    x, y = _feed_data(24)
+    reg = MetricsRegistry()
+    pool = FeedWorkerPool(x, y, 12, num_workers=1, backend="thread",
+                          seed=5, poll_s=0.02, registry=reg,
+                          slow_detect=True)
+    try:
+        pool._recycle_worker(0)          # even a direct conviction
+        assert pool._retired == set()
+        assert reg.snapshot()["feed_worker_recycled_total"] == 0
+        assert pool.alive_workers() == 1
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# shipped gray-failure alert pack
+# ---------------------------------------------------------------------------
+
+def test_gray_failure_alert_rules_shape_and_fire():
+    from dcnn_tpu.obs.registry import MetricsRegistry
+    from dcnn_tpu.obs.rules import RuleEngine, gray_failure_alert_rules
+    from dcnn_tpu.obs.tsdb import TimeSeriesStore
+
+    rules = gray_failure_alert_rules()
+    assert [r.name for r in rules] == [
+        "gray_straggler_convicted", "gray_stage_imbalance_sustained",
+        "gray_hedge_rate_high", "gray_replica_probation"]
+    by_name = {r.name: r for r in rules}
+    assert by_name["gray_straggler_convicted"].severity == "page"
+    assert by_name["gray_straggler_convicted"].for_s == 0.0
+    assert by_name["gray_replica_probation"].fn == "min_over_time"
+
+    # a conviction pages on the very next evaluation (for_s=0)
+    fc = FakeClock()
+    store = TimeSeriesStore(clock=fc)
+    eng = RuleEngine(store, registry=MetricsRegistry(clock=fc), clock=fc)
+    for r in rules:
+        eng.add_alert(r)
+    for v in (0.0, 0.0, 1.0):
+        fc.advance(10.0)
+        store.add("elastic_stragglers_evicted_total", v)
+    trs = eng.evaluate()
+    fired = [t for t in trs if t["to"] == "firing"]
+    assert [t["rule"] for t in fired] == ["gray_straggler_convicted"]
