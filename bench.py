@@ -102,7 +102,13 @@ emitted under an "autoscale" key: availability / slo_violation_minutes /
 scale_up_reaction_s regression-gated via autoscale.* in
 dcnn_tpu/obs/regress.py; knobs BENCH_AUTOSCALE_SECONDS default 240,
 BENCH_AUTOSCALE_PEAK_RPS/_TROUGH_RPS default 200/20;
-docs/deployment.md §6).
+docs/deployment.md §6), BENCH_DECODE=1 for the continuous-batching
+decode probe (dcnn_tpu/serve/decode.py — emitted under a "decode" key:
+generated tokens/s, TTFT p99, and slot occupancy for the iteration-level
+scheduler vs the sequential batch-of-one baseline on the same synthetic
+length mix, decode.* regression-gated via dcnn_tpu/obs/regress.py; knobs
+BENCH_DECODE_SLOTS default 8, BENCH_DECODE_SEQS default 24;
+docs/deployment.md §"Generative serving").
 """
 
 from __future__ import annotations
@@ -1068,6 +1074,110 @@ def autoscale_section():
                 pass
 
 
+def decode_section():
+    """BENCH_DECODE=1 ``decode`` block: continuous-batching autoregressive
+    decode (dcnn_tpu/serve/decode.py) vs the naive batch-of-one baseline
+    — SAME engine, SAME compiled sessions, SAME synthetic length mix, so
+    the delta is pure scheduling. The naive path is ``decode_reference``
+    run sequentially (each sequence decodes alone at batch bucket 1 —
+    occupancy 1/max_slots by construction); the continuous path is the
+    iteration-level scheduler admitting into free slots at step
+    boundaries. Engine construction (compiles) is excluded from both
+    timings.
+
+    Regression-gated keys (obs/regress.py ``decode.*``):
+    ``tokens_per_sec`` (generated tokens only), ``ttft_p99_ms``
+    (submit → first generated token across the whole run), and
+    ``slot_occupancy`` (mean active/max over steps) — guarded on
+    ``max_slots``. Knobs: BENCH_DECODE_SLOTS (default 8),
+    BENCH_DECODE_SEQS (default 24)."""
+    import jax
+    import numpy as np
+
+    from dcnn_tpu.models import MHADecoder
+    from dcnn_tpu.serve import (ContinuousBatcher, DecodeEngine,
+                                decode_reference)
+    from dcnn_tpu.serve.metrics import DecodeMetrics
+
+    max_slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    n_seqs = int(os.environ.get("BENCH_DECODE_SEQS", "24"))
+    model = MHADecoder(vocab_size=32, embed_dim=32, num_heads=2,
+                       num_layers=2, max_seq_len=64)
+    params = model.init(jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    engine = DecodeEngine(model, params, max_slots=max_slots, page_size=8,
+                          max_pages_per_seq=4, aot_cache=False,
+                          name="bench-decode")
+    build_s = time.perf_counter() - t0
+
+    # synthetic length mix: short chats to long generations, seeded so
+    # every capture decodes the identical workload
+    rng = np.random.default_rng(0)
+    seqs = []
+    for _ in range(n_seqs):
+        plen = int(rng.integers(2, 12))
+        max_new = int(rng.integers(4, engine.max_context - plen))
+        prompt = rng.integers(0, model.vocab_size, size=plen).tolist()
+        seqs.append((prompt, max_new))
+
+    # naive baseline: strictly sequential batch-of-one (slot occupancy is
+    # 1/max_slots per step by construction — one resident sequence)
+    naive_tokens = 0
+    naive_ttft = []
+    t0 = time.perf_counter()
+    for prompt, max_new in seqs:
+        t_seq = time.perf_counter()
+        got = decode_reference(engine, prompt, max_new_tokens=max_new)
+        # first token lands after this sequence's prefill, which starts
+        # only when every earlier sequence finished — that serialization
+        # IS the baseline's TTFT story
+        naive_ttft.append((t_seq - t0)
+                          + (time.perf_counter() - t_seq) / max(len(got), 1))
+        naive_tokens += len(got)
+    naive_wall = time.perf_counter() - t0
+
+    # continuous: same sequences, iteration-level scheduler, sync-driven
+    metrics = DecodeMetrics()
+    batcher = ContinuousBatcher(engine, metrics=metrics,
+                                queue_capacity=n_seqs, start=False)
+    futs = [batcher.submit(p, max_new_tokens=mn) for p, mn in seqs]
+    t0 = time.perf_counter()
+    while batcher.step():
+        pass
+    cont_wall = time.perf_counter() - t0
+    results = [f.result(timeout=5) for f in futs]
+    cont_tokens = sum(len(r) for r in results)
+    s = metrics.snapshot()
+
+    naive_ttft.sort()
+    p99_i = min(int(0.99 * (len(naive_ttft) - 1) + 0.5),
+                len(naive_ttft) - 1)
+    naive_tps = naive_tokens / naive_wall if naive_wall > 0 else None
+    cont_tps = cont_tokens / cont_wall if cont_wall > 0 else None
+    return {
+        "max_slots": max_slots,
+        "sequences": n_seqs,
+        "page_size": engine.page_size,
+        "pool_pages": engine.pool.num_pages,
+        "engine_build_s": round(build_s, 3),
+        "generated_tokens": cont_tokens,
+        "steps": s["steps"],
+        "evictions": s["evictions"],
+        "tokens_per_sec": round(cont_tps, 1) if cont_tps else None,
+        "tokens_per_sec_naive": round(naive_tps, 1) if naive_tps else None,
+        "speedup_x": (round(cont_tps / naive_tps, 2)
+                      if cont_tps and naive_tps else None),
+        "ttft_p99_ms": (round(s["ttft_p99_ms"], 3)
+                        if s["ttft_p99_ms"] is not None else None),
+        "ttft_p99_ms_naive": round(naive_ttft[p99_i] * 1e3, 3),
+        "slot_occupancy": (round(s["slot_occupancy"], 4)
+                           if s["slot_occupancy"] is not None else None),
+        "slot_occupancy_naive": round(1 / max_slots, 4),
+        "wall_seconds": round(cont_wall, 3),
+        "wall_seconds_naive": round(naive_wall, 3),
+    }
+
+
 def faults_section():
     """BENCH_FAULTS=1: the measured cost of robustness — checkpoint
     save/restore wall for a real model's train state, sync vs async (the
@@ -1856,6 +1966,11 @@ def main() -> None:
     # nearly free — the soak runs on a fake clock, zero real sleeps)
     if os.environ.get("BENCH_AUTOSCALE", "0") == "1":
         out["autoscale"] = autoscale_section()
+
+    # continuous-batching decode vs naive batch-of-one (opt-in — a
+    # ~dozen tiny-model compiles plus a few thousand decode steps)
+    if os.environ.get("BENCH_DECODE", "0") == "1":
+        out["decode"] = decode_section()
 
     if os.environ.get("BENCH_MATRIX"):
         from dcnn_tpu.core.precision import set_precision

@@ -144,6 +144,25 @@ def train_step_key_material(model: Any, optimizer: Any, loss_fn: Any, *,
     }
 
 
+def decode_step_key_material(model: Any, *, page_size: int,
+                             num_pages: int, weights: str,
+                             kind: str = "decode_step") -> Dict[str, Any]:
+    """Canonical key material for a paged decode step
+    (``serve/decode.py``): model config (layer count/dims shape the
+    program), the page geometry (page size and pool size are baked into
+    the scatter/gather shapes), and the **weights digest**
+    (:func:`digest_arrays` — the step closes over the checkpoint as
+    constants, exactly like the serving graphs in ``serve/engine``).
+    The batch/page buckets ride the aval signature, not this dict."""
+    return {
+        "model": model.get_config(),
+        "page_size": int(page_size),
+        "num_pages": int(num_pages),
+        "weights": weights,
+        "kind": kind,
+    }
+
+
 def digest(obj: Any) -> str:
     """Stable SHA-256 of any JSON-able structure (non-JSON leaves fall
     back to ``repr``, which is stable for the repo's config objects)."""

@@ -1,5 +1,7 @@
-"""Model zoo (reference ``include/nn/example_models.hpp:13-404``)."""
+"""Model zoo (reference ``include/nn/example_models.hpp:13-404``) plus the
+generative-serving decoder family (``decoder.py``, no reference analog)."""
 
+from .decoder import MHADecoder, create_mha_decoder
 from .zoo import (
     MODEL_ZOO, create_cifar10_trainer_v1, create_cifar10_trainer_v2,
     create_cnn_cifar100, create_cnn_tiny_imagenet, create_mha_classifier,
@@ -13,6 +15,7 @@ from .zoo import (
 
 __all__ = [
     "MODEL_ZOO", "create_model",
+    "MHADecoder", "create_mha_decoder",
     "create_mnist_trainer", "create_cifar10_trainer_v1", "create_cifar10_trainer_v2",
     "create_cnn_cifar100", "create_mha_classifier",
     "create_resnet9_cifar10", "create_resnet18_cifar10", "create_resnet20_cifar10",

@@ -347,6 +347,18 @@ def create_mha_classifier(data_format: str = "NCHW") -> Sequential:
             .build())
 
 
+def _create_mha_decoder(data_format: str = "NCHW"):
+    """Causal decoder for generative serving (models/decoder.py) — lazy
+    import so the zoo stays importable without pulling the decode stack."""
+    from .decoder import create_mha_decoder
+    return create_mha_decoder(data_format)
+
+
+# zoo values are Sequential factories with one exception: "mha_decoder"
+# builds models.decoder.MHADecoder — token input + per-layer KV state
+# don't fit the (B, *input_shape) float Sequential contract, but the
+# generative-serving stack (serve/decode.py) still deserves a factory
+# entry discoverable next to its classifier siblings.
 MODEL_ZOO: Dict[str, Callable[..., Sequential]] = {
     "mnist_cnn": create_mnist_trainer,
     "cifar10_cnn_v1": create_cifar10_trainer_v1,
@@ -363,6 +375,7 @@ MODEL_ZOO: Dict[str, Callable[..., Sequential]] = {
     "resnet50_tiny_imagenet": create_resnet50_tiny_imagenet,
     "resnet50_imagenet": create_resnet50_imagenet,
     "mha_classifier": create_mha_classifier,
+    "mha_decoder": _create_mha_decoder,
 }
 
 

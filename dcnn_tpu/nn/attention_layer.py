@@ -132,3 +132,61 @@ class MultiHeadAttentionLayer(MHAGeometryMixin, ParameterizedLayer):
         q, k, v = self._qkv(params, x)
         o = self._attend(q, k, v)
         return self._project(o, params["wo"], params.get("bo")), state
+
+    # -- single-token decode path (serve/decode.py) ------------------------
+    def decode_qkv(self, params, x_t):
+        """Single-token projections: ``x_t (B, E)`` → ``(q, k, v)`` each
+        ``(B, E)``. The ``k``/``v`` rows are what a decode step writes into
+        its KV cache; ``q`` goes to :meth:`decode_attend`."""
+        get = params.get
+        return (self._project(x_t, params["wq"], get("bq")),
+                self._project(x_t, params["wk"], get("bk")),
+                self._project(x_t, params["wv"], get("bv")))
+
+    def decode_attend(self, params, q_t, k_ctx, v_ctx, positions):
+        """One causal decode step against a materialized KV context.
+
+        ``q_t (B, E)`` attends to ``k_ctx``/``v_ctx (B, T, E)`` at absolute
+        position ``positions (B,)`` int32: key slot ``j`` participates iff
+        ``j <= position`` (the causal mask a token at ``position`` sees).
+        Rows with ``position < 0`` are fully masked and return 0 — the
+        inactive-slot convention, same zero-mass rule as
+        :func:`~dcnn_tpu.ops.attention.attention`. Returns ``y_t (B, E)``
+        after the out projection.
+        """
+        from ..ops.attention import NEG_INF
+
+        b_, t, e = k_ctx.shape
+        h, dh = self.num_heads, e // self.num_heads
+        q = q_t.reshape(b_, h, dh)
+        k = k_ctx.reshape(b_, t, h, dh).transpose(0, 2, 1, 3)
+        v = v_ctx.reshape(b_, t, h, dh).transpose(0, 2, 1, 3)
+        scale = dh ** -0.5
+        s = jnp.einsum("bhd,bhtd->bht", q, k,
+                       precision=get_precision()) * scale
+        valid = (jnp.arange(t, dtype=positions.dtype)[None, :]
+                 <= positions[:, None])           # (B, T), False row if pos<0
+        s = jnp.where(valid[:, None, :], s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        # zero fully-masked rows (softmax of all-NEG_INF is uniform 1/T)
+        w = jnp.where(valid[:, None, :], w, 0.0)
+        o = jnp.einsum("bht,bhtd->bhd", w, v, precision=get_precision())
+        return self._project(o.reshape(b_, e), params["wo"],
+                             params.get("bo"))
+
+    def decode(self, params, state, x_t, k_cache, v_cache, positions):
+        """Single-token decode through an explicit dense KV cache: write
+        this token's K/V rows at ``positions``, attend over the prefix,
+        return ``(y_t, k_cache, v_cache)``. ``x_t (B, E)``; caches
+        ``(B, T, E)``; ``positions (B,)`` int32 (``-1`` = inactive row:
+        nothing attends, and the write lands on slot 0 of an all-masked
+        row, which nothing ever reads). The paged serving path
+        (``serve/decode.py``) does the same dance against a page pool."""
+        q, k_t, v_t = self.decode_qkv(params, x_t)
+        b_ = x_t.shape[0]
+        rows = jnp.arange(b_)
+        pos_c = jnp.maximum(positions, 0)
+        k_cache = k_cache.at[rows, pos_c].set(k_t)
+        v_cache = v_cache.at[rows, pos_c].set(v_t)
+        return (self.decode_attend(params, q, k_cache, v_cache, positions),
+                k_cache, v_cache)

@@ -98,6 +98,8 @@ SPAN_BUCKETS: Dict[str, Optional[str]] = {
     "serve.warmup": "compile",
     "serve.request": None,
     "serve.shed": None,
+    # continuous-batching decode (serve/decode.py)
+    "decode.step": "compute",
     # checkpointing
     "checkpoint.save": "checkpoint",
     "checkpoint.restore": "checkpoint",

@@ -183,6 +183,19 @@ DEFAULT_METRICS: Sequence[MetricSpec] = (
     MetricSpec("gray.hedge_p99_ratio", "resilience.gray.hedge_p99_ratio",
                higher_is_better=False, tolerance=0.5, atol=0.5,
                guard="resilience.gray.hedge_replicas"),
+    # continuous-batching decode (BENCH_DECODE=1 `decode` block,
+    # ISSUE 20): generated tokens/s and mean slot occupancy for the
+    # continuous batcher on the synthetic length mix; TTFT p99 is a
+    # loopback sub-10ms wall, so wide relative tolerance + atol slack
+    # (scheduler noise dominates). Guards pin the probe's slot count —
+    # pre-r20 captures lack the block and are skipped, not lied about.
+    MetricSpec("decode.tokens_per_sec", "decode.tokens_per_sec",
+               tolerance=0.3, guard="decode.max_slots"),
+    MetricSpec("decode.ttft_p99_ms", "decode.ttft_p99_ms",
+               higher_is_better=False, tolerance=1.0, atol=10.0,
+               guard="decode.max_slots"),
+    MetricSpec("decode.slot_occupancy", "decode.slot_occupancy",
+               tolerance=0.25, guard="decode.max_slots"),
 )
 
 DEFAULT_TOLERANCE = 0.2

@@ -89,6 +89,17 @@ Trip points wired in this PR (grep for ``faults.trip`` to enumerate):
 ``aot.load``                    fail an executable-cache lookup before its
                                 read — the warm path must degrade to a
                                 transparent recompile, never an error
+``decode.admit``                fail admitting sequence ``at=i`` into the
+                                continuous decode batch: ``InjectedFault``
+                                fails just that sequence's future, typed;
+                                ``InjectedCrash`` escalates to the step
+                                handler (``serve/decode.py``)
+``decode.step``                 fire before decode step ``at=k`` dispatches —
+                                armed with ``exc=InjectedCrash`` it is the
+                                scheduler-died-mid-decode simulation: every
+                                accepted sequence (active AND queued) fails
+                                typed, none silently dropped
+                                (``serve/decode.py``)
 ``elastic.slow_peer``           delay hook (``FaultPlan.slow``) in the
                                 elastic step's local-compute window — makes
                                 this peer a straggler without killing it

@@ -50,20 +50,42 @@ shrinks it with drain-then-remove decommission, and — via
 in :mod:`dcnn_tpu.parallel.autoscale` — hands chips back and forth with
 the training world on shared hardware.
 
+**Generative decode** (ISSUE 20) is the iterative sibling of the one-shot
+path above — requests hold a slot for many steps and finish at
+data-dependent lengths, so batching is *iteration-level*
+(docs/deployment.md §"Generative serving"):
+
+- :class:`~dcnn_tpu.serve.kvcache.KVPagePool` — paged KV cache: fixed
+  pages, free-list recycling, per-sequence page tables, null page 0;
+  sized off live HBM headroom (:func:`~dcnn_tpu.serve.kvcache.suggest_num_pages`);
+- :class:`~dcnn_tpu.serve.decode.DecodeEngine` — ONE jitted paged decode
+  step compiled per (batch-bucket, page-bucket) at construction, AOT
+  warmable, so admission never compiles;
+- :class:`~dcnn_tpu.serve.decode.ContinuousBatcher` — admits at step
+  boundaries, retires per sequence, preempts-and-recomputes on page
+  exhaustion; per-sequence output bit-identical to
+  :func:`~dcnn_tpu.serve.decode.decode_reference` (batch of one);
+- :class:`~dcnn_tpu.serve.metrics.DecodeMetrics` — tokens/s, TTFT,
+  slot occupancy, page occupancy on the standard scrape surface.
+
 End-to-end drivers: ``examples/serve_snapshot.py`` (committed digits28
 snapshot under open-loop traffic), ``examples/serve_router.py`` (the
 router tier: replica kill + rejoin + hot-swap),
 ``examples/serve_autoscale.py`` (the autoscaler's diurnal soak +
-device-lease handoff), and ``BENCH_SERVE=1 / BENCH_AUTOSCALE=1
-python bench.py`` (latency-vs-offered-load curve + ``router`` +
-``autoscale`` blocks). Quickstart: docs/deployment.md §5–6.
+device-lease handoff), ``examples/serve_decode.py`` (continuous-batching
+decode + the bit-identity check), and ``BENCH_SERVE=1 / BENCH_AUTOSCALE=1
+/ BENCH_DECODE=1 python bench.py`` (latency-vs-offered-load curve +
+``router`` + ``autoscale`` + ``decode`` blocks). Quickstart:
+docs/deployment.md §5–6.
 """
 
 from .engine import InferenceEngine, serve_buckets
 from .batcher import (
     DrainingError, DynamicBatcher, QueueFullError, ShutdownError,
 )
-from .metrics import PRIORITIES, RouterMetrics, ServeMetrics
+from .metrics import DecodeMetrics, PRIORITIES, RouterMetrics, ServeMetrics
+from .kvcache import KVPagePool, OutOfPagesError, suggest_num_pages
+from .decode import ContinuousBatcher, DecodeEngine, decode_reference
 from .replica import (
     LocalReplica, ReplicaDeadError, ReplicaError, ReplicaServer, SwapError,
     TcpReplica,
@@ -79,7 +101,9 @@ from .autoscale import (
 __all__ = [
     "InferenceEngine", "serve_buckets",
     "DynamicBatcher", "DrainingError", "QueueFullError", "ShutdownError",
-    "ServeMetrics", "RouterMetrics", "PRIORITIES",
+    "ServeMetrics", "RouterMetrics", "DecodeMetrics", "PRIORITIES",
+    "KVPagePool", "OutOfPagesError", "suggest_num_pages",
+    "DecodeEngine", "ContinuousBatcher", "decode_reference",
     "LocalReplica", "TcpReplica", "ReplicaServer",
     "ReplicaError", "ReplicaDeadError", "SwapError",
     "Router", "RouterShedError", "NoReplicasError",

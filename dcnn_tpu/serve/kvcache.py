@@ -1,0 +1,193 @@
+"""Paged KV-cache: fixed-size page pool + per-sequence page tables.
+
+The memory problem generative serving actually has (the vLLM observation):
+a dense per-slot KV cache must be provisioned for the *longest possible*
+sequence, so a fleet of mostly-short requests wastes most of its cache HBM
+on padding, and slot count — hence batch occupancy, hence tokens/s — is
+capped by the worst case instead of the working set. Paging fixes both:
+the cache is one pool of fixed-size pages (``page_size`` token slots per
+page, per layer), a sequence owns only the pages its current length
+needs, pages recycle through a free list the moment a sequence completes,
+and a sequence's *logical* positions map to *physical* pool slots through
+its page table — which is exactly the indirection the decode step's
+gather/scatter consumes (``serve/decode.py``).
+
+Layout: ``k``/``v`` are ``(num_layers, num_pages, page_size, embed_dim)``
+device arrays. **Page 0 is the null page**: never allocated, target of
+every padded page-table entry and of inactive batch rows' writes. Active
+sequences never read it — the decode mask excludes positions past a
+sequence's length — so colliding garbage writes land where they can't be
+observed, and the step function needs no scatter predication.
+
+Sizing: :func:`suggest_num_pages` turns the live HBM headroom
+(``obs/xla.sample_hbm`` — in-use vs limit, the same gauges the watermark
+rides) into a page budget, with an explicit default for backends that
+report no memory stats (CPU). The engine reports its executables'
+``memory_analysis`` bytes alongside (``obs/xla.executable_cost``), so a
+capture shows both what the pool took and what the step needs.
+
+Thread-safety: the allocator's bookkeeping (free list, tables) is guarded
+by one lock — the continuous batcher calls it from its scheduler thread
+while telemetry reads occupancy from scrape threads. The ``k``/``v``
+arrays themselves are owned by the engine step loop (single writer).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class OutOfPagesError(RuntimeError):
+    """The page pool is exhausted — a *typed* allocation failure so the
+    scheduler can preempt-and-recompute (release a victim's pages, requeue
+    it) instead of crashing mid-step."""
+
+
+class KVPagePool:
+    """Fixed page pool + free list + per-sequence page tables.
+
+    ``pages_for(length)`` pages hold a ``length``-token sequence;
+    :meth:`ensure` grows a sequence's table to cover a target length and
+    raises :class:`OutOfPagesError` (allocating nothing) when the free
+    list can't; :meth:`release` returns a completed sequence's pages to
+    the free list. :meth:`table` renders the page table padded to a
+    bucket width with null-page zeros — the fixed-shape array the
+    compiled decode step indexes with.
+    """
+
+    def __init__(self, *, num_layers: int, embed_dim: int,
+                 page_size: int = 8, num_pages: int = 64,
+                 dtype: Any = jnp.float32):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if num_pages < 2:
+            raise ValueError(f"num_pages must be >= 2 (page 0 is the null "
+                             f"page), got {num_pages}")
+        self.num_layers = int(num_layers)
+        self.embed_dim = int(embed_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.embed_dim)
+        # engine-owned device state: the step loop threads these through
+        # the compiled step and writes the updated arrays back
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self._lock = threading.Lock()
+        # page 0 excluded: it is the null page (module docstring)
+        self._free: deque = deque(range(1, self.num_pages))  # dcnn: guarded_by=_lock
+        self._tables: Dict[Any, List[int]] = {}  # dcnn: guarded_by=_lock
+
+    # -- geometry --
+    def pages_for(self, length: int) -> int:
+        """Pages a ``length``-token sequence occupies (0 for length 0)."""
+        return -(-int(length) // self.page_size)
+
+    @property
+    def page_bytes(self) -> int:
+        """Device bytes one page costs across K+V and all layers — the
+        unit :func:`suggest_num_pages` budgets in."""
+        return (2 * self.num_layers * self.page_size * self.embed_dim
+                * self.dtype.itemsize)
+
+    @property
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def num_seq_pages(self, seq_id: Any) -> int:
+        with self._lock:
+            return len(self._tables.get(seq_id, ()))
+
+    # -- allocation --
+    def ensure(self, seq_id: Any, length: int) -> int:
+        """Grow ``seq_id``'s page table until it covers ``length`` tokens.
+        Returns the table's page count. All-or-nothing: raises
+        :class:`OutOfPagesError` without allocating anything when the
+        free list can't cover the growth, so a failed extension never
+        leaks partial pages."""
+        need = self.pages_for(length)
+        with self._lock:
+            table = self._tables.setdefault(seq_id, [])
+            grow = need - len(table)
+            if grow <= 0:
+                return len(table)
+            if grow > len(self._free):
+                raise OutOfPagesError(
+                    f"sequence {seq_id!r} needs {grow} more page(s) for "
+                    f"length {length}; only {len(self._free)} of "
+                    f"{self.num_pages - 1} allocatable pages free")
+            table.extend(self._free.popleft() for _ in range(grow))
+            return len(table)
+
+    def release(self, seq_id: Any) -> int:
+        """Return ``seq_id``'s pages to the free list (recycling on
+        completion/preemption). Unknown ids are a no-op — release must be
+        safe to call from every teardown path. Returns pages freed."""
+        with self._lock:
+            table = self._tables.pop(seq_id, [])
+            self._free.extend(table)
+            return len(table)
+
+    def table(self, seq_id: Any, width: int) -> np.ndarray:
+        """``seq_id``'s page table as int32, zero-padded to ``width``
+        entries (padding = the null page). ``width`` is the page bucket
+        the scheduler picked; a table longer than ``width`` is a caller
+        bug and raises."""
+        with self._lock:
+            table = list(self._tables.get(seq_id, ()))
+        if len(table) > width:
+            raise ValueError(f"sequence {seq_id!r} holds {len(table)} "
+                             f"pages > table width {width}")
+        out = np.zeros(width, np.int32)
+        out[:len(table)] = table
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            in_use = (self.num_pages - 1) - len(self._free)
+            seqs = len(self._tables)
+        return {"num_pages": self.num_pages, "page_size": self.page_size,
+                "pages_in_use": in_use,
+                "pages_free": (self.num_pages - 1) - in_use,
+                "sequences": seqs, "page_bytes": self.page_bytes}
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        return (f"KVPagePool(layers={self.num_layers}, "
+                f"pages={self.num_pages}x{self.page_size}, "
+                f"embed={self.embed_dim}, in_use={s['pages_in_use']})")
+
+
+def suggest_num_pages(page_bytes: int, *, fraction: float = 0.2,
+                      default: int = 64, cap: int = 4096,
+                      registry=None) -> int:
+    """Size the page pool off live HBM headroom: ``fraction`` of
+    (limit − in-use) from :func:`~dcnn_tpu.obs.xla.sample_hbm`, in units
+    of ``page_bytes`` (:attr:`KVPagePool.page_bytes`), clamped to
+    ``[2, cap]``. Backends without memory stats (CPU) get ``default`` —
+    an explicit number, not a guess dressed up as telemetry."""
+    if page_bytes < 1:
+        raise ValueError(f"page_bytes must be >= 1, got {page_bytes}")
+    if not 0 < fraction <= 1:
+        raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+    from ..obs.xla import sample_hbm
+
+    hbm = sample_hbm(registry)
+    if not hbm or not hbm.get("hbm_bytes_limit"):
+        return default
+    headroom = max(hbm["hbm_bytes_limit"] - hbm.get("hbm_bytes_in_use", 0.0),
+                   0.0)
+    return int(min(max(headroom * fraction // page_bytes, 2), cap))

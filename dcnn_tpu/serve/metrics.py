@@ -528,3 +528,218 @@ class RouterMetrics:
         t = self.snapshot()["total"]
         return (f"RouterMetrics(completed={t['completed']}, "
                 f"shed={t['shed']}, failed={t['failed']})")
+
+
+class DecodeMetrics:
+    """Continuous-batching decode telemetry (``serve/decode.py``), on the
+    :class:`ServeMetrics` rules — injectable clock, O(1) thread-safe
+    recorders, a private per-instance registry unless ``registry=`` pools
+    one, one-lock :meth:`snapshot`, derived windowed views appended as
+    gauges in :meth:`prometheus`.
+
+    The decode plane's own vocabulary: **tokens** (generated — the unit
+    throughput is priced in) vs **prefill tokens** (prompt/replay steps
+    that write KV but emit nothing new), **slots** (iteration-level batch
+    rows; occupancy = active/max over the step window is the metric
+    continuous batching exists to raise), **pages**
+    (:class:`~dcnn_tpu.serve.kvcache.KVPagePool` occupancy), admissions /
+    evictions (preempt-and-recompute), and **TTFT** (submit → first
+    generated token, the latency decode users actually feel).
+    """
+
+    def __init__(self, *, window: int = 4096,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._clock = clock
+        self._window = window
+        self._lock = threading.Lock()
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(clock=clock))
+        r = self.registry
+        self._submitted = r.counter(
+            "decode_sequences_submitted_total",
+            "sequences accepted into the decode queue")
+        self._shed = r.counter(
+            "decode_sequences_shed_total",
+            "sequences rejected by decode-queue backpressure")
+        self._admissions = r.counter(
+            "decode_admissions_total",
+            "sequences admitted into a running batch at a step boundary")
+        self._evictions = r.counter(
+            "decode_evictions_total",
+            "sequences preempted to the queue on page exhaustion "
+            "(recompute-on-readmission)")
+        self._completions = r.counter(
+            "decode_completions_total",
+            "sequences decoded to max_new_tokens or EOS")
+        self._tokens = r.counter(
+            "decode_tokens_total", "tokens generated (emission steps)")
+        self._prefill = r.counter(
+            "decode_prefill_tokens_total",
+            "prompt/replay tokens consumed (KV written, nothing emitted)")
+        self._steps = r.counter(
+            "decode_steps_total", "fixed-shape decode steps dispatched")
+        self._active = r.gauge(
+            "decode_active_slots", "sequences resident in decode slots")
+        self._pages = r.gauge(
+            "decode_pages_in_use", "KV pages currently allocated")
+        self._queue_depth = r.gauge(
+            "decode_queue_depth", "sequences waiting for a slot")
+        self._ttft_hist = r.histogram(
+            "decode_ttft_seconds",
+            "time to first generated token (submit to first emission)")
+        self._init_local()
+
+    def _init_local(self) -> None:
+        with self._lock:
+            self._ttft_s: deque = deque(maxlen=self._window)
+            self._occ: deque = deque(maxlen=self._window)
+            self._counts = {k: 0 for k in (
+                "submitted", "shed", "admitted", "evicted", "completed",
+                "tokens", "prefill_tokens", "steps")}
+            self._active_n = 0
+            self._pages_n = 0
+            self._depth_n = 0
+            self._t0 = self._clock()
+
+    def reset(self) -> None:
+        """Zero everything and restart the throughput wall-clock —
+        including this instance's registry instruments (same explicit-
+        decision semantics as :meth:`ServeMetrics.reset`)."""
+        self._init_local()
+        for inst in (self._submitted, self._shed, self._admissions,
+                     self._evictions, self._completions, self._tokens,
+                     self._prefill, self._steps, self._active, self._pages,
+                     self._queue_depth, self._ttft_hist):
+            inst.reset()
+
+    # -- recorders (all O(1), thread-safe) --
+    def record_submit(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["submitted"] += n
+        self._submitted.inc(n)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["shed"] += n
+        self._shed.inc(n)
+
+    def record_admit(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["admitted"] += n
+        self._admissions.inc(n)
+
+    def record_evict(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["evicted"] += n
+        self._evictions.inc(n)
+
+    def record_complete(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["completed"] += n
+        self._completions.inc(n)
+
+    def record_token(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["tokens"] += n
+        self._tokens.inc(n)
+
+    def record_prefill(self, n: int = 1) -> None:
+        with self._lock:
+            self._counts["prefill_tokens"] += n
+        self._prefill.inc(n)
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._ttft_s.append(seconds)
+        self._ttft_hist.observe(seconds)
+
+    def record_step(self, active: int, max_slots: int) -> None:
+        """One decode step ran with ``active`` of ``max_slots`` slots
+        occupied — the occupancy sample continuous batching is judged
+        on."""
+        with self._lock:
+            self._counts["steps"] += 1
+            self._occ.append(active / max(max_slots, 1))
+            self._active_n = active
+        self._steps.inc()
+        self._active.set(active)
+
+    def record_pages(self, pages_in_use: int) -> None:
+        with self._lock:
+            self._pages_n = pages_in_use
+        self._pages.set(pages_in_use)
+
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._depth_n = depth
+        self._queue_depth.set(depth)
+
+    # -- export --
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """Point-in-time view under ONE lock. TTFT keys and
+        ``slot_occupancy`` are ``None`` until data exists (no-data is not
+        zero); ``tokens_per_sec`` prices GENERATED tokens only — prefill
+        rides ``prefill_tokens`` so the two are never conflated."""
+        with self._lock:
+            now = self._clock()
+            ttft = sorted(self._ttft_s)
+            occ = list(self._occ)
+            c = dict(self._counts)
+            active, pages = self._active_n, self._pages_n
+            depth = self._depth_n
+            wall_s = max(now - self._t0, 0.0)
+
+        def pct(q: float) -> Optional[float]:
+            if not ttft:
+                return None
+            i = min(int(q * (len(ttft) - 1) + 0.5), len(ttft) - 1)
+            return ttft[i] * 1e3
+
+        return {
+            "sequences_submitted": c["submitted"],
+            "sequences_shed": c["shed"],
+            "admissions": c["admitted"],
+            "evictions": c["evicted"],
+            "completions": c["completed"],
+            "tokens": c["tokens"],
+            "prefill_tokens": c["prefill_tokens"],
+            "steps": c["steps"],
+            "active_slots": active,
+            "pages_in_use": pages,
+            "queue_depth": depth,
+            "slot_occupancy": (sum(occ) / len(occ)) if occ else None,
+            "ttft_p50_ms": pct(0.50),
+            "ttft_p99_ms": pct(0.99),
+            "ttft_mean_ms": (sum(ttft) / len(ttft) * 1e3) if ttft else None,
+            "tokens_per_sec": (c["tokens"] / wall_s) if wall_s > 0 else None,
+            "wall_s": wall_s,
+        }
+
+    def prometheus(self) -> str:
+        """Registry instruments plus the derived windowed views appended
+        as gauges (same split as :meth:`ServeMetrics.prometheus`)."""
+        from ..obs.exposition import render_scalar
+
+        s = self.snapshot()
+        lines = [self.registry.prometheus().rstrip("\n")]
+        derived = {
+            "decode_ttft_window_p50_ms": s["ttft_p50_ms"],
+            "decode_ttft_window_p99_ms": s["ttft_p99_ms"],
+            "decode_slot_occupancy": s["slot_occupancy"],
+            "decode_tokens_per_sec": s["tokens_per_sec"],
+        }
+        for name, v in derived.items():
+            if v is None:
+                continue  # absent series, not a lying 0.0
+            lines.extend(render_scalar(
+                name, "gauge", v))  # dcnn: metric=decode_ttft_window_*_ms,decode_slot_occupancy,decode_tokens_per_sec
+        return "\n".join(lines) + "\n"
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        return (f"DecodeMetrics(tokens={s['tokens']}, "
+                f"completions={s['completions']}, "
+                f"occupancy={s['slot_occupancy']})")

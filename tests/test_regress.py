@@ -270,3 +270,61 @@ def test_autoscale_zero_best_window_uses_absolute_slack():
                               _autoscale_cap(slo_min=3.0, reaction=30.0)])
     assert "autoscale.slo_violation_minutes" in report["regressions"]
     assert "autoscale.scale_up_reaction_s" in report["regressions"]
+
+
+# ------------------------------------------- decode.* gate keys (PR 20)
+
+def _decode_cap(tps=5000.0, ttft=50.0, occ=0.8, slots=8, **extra):
+    return {"value": 100.0, "decode": {
+        "tokens_per_sec": tps,
+        "ttft_p99_ms": ttft,
+        "slot_occupancy": occ,
+        "max_slots": slots, **extra}}
+
+
+def test_decode_keys_skip_for_pre_pr20_captures():
+    """Skips-not-lies: histories without the BENCH_DECODE block neither
+    gate nor fail the decode keys, in either direction."""
+    report = regress.compare([{"value": 100.0}, {"value": 101.0},
+                              _decode_cap()])
+    assert report["ok"]
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert rows["decode.tokens_per_sec"]["verdict"] \
+        == "skipped: no comparable prior capture"
+    report = regress.compare([_decode_cap(), {"value": 100.0}])
+    assert report["ok"]
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert "absent from newest" in rows["decode.tokens_per_sec"]["verdict"]
+
+
+def test_decode_throughput_and_occupancy_regressions_flagged():
+    report = regress.compare([_decode_cap(tps=5000.0),
+                              _decode_cap(tps=2000.0)])
+    assert "decode.tokens_per_sec" in report["regressions"]
+    report = regress.compare([_decode_cap(occ=0.8), _decode_cap(occ=0.4)])
+    assert "decode.slot_occupancy" in report["regressions"]
+    # within tolerance: passes
+    report = regress.compare([_decode_cap(tps=5000.0, occ=0.8),
+                              _decode_cap(tps=4500.0, occ=0.75)])
+    assert report["ok"]
+
+
+def test_decode_ttft_lower_is_better_with_absolute_slack():
+    """TTFT is a sub-100ms loopback wall: the atol shields sub-10ms
+    scheduler jitter, but a real blowup is flagged."""
+    report = regress.compare([_decode_cap(ttft=5.0), _decode_cap(ttft=12.0)])
+    assert report["ok"]   # within 1.0 rel + 10ms atol slack
+    report = regress.compare([_decode_cap(ttft=50.0),
+                              _decode_cap(ttft=300.0)])
+    assert "decode.ttft_p99_ms" in report["regressions"]
+
+
+def test_decode_keys_guarded_on_slot_count():
+    """A different max_slots is a different probe — guard refuses the
+    comparison instead of calling a config change a regression."""
+    report = regress.compare([_decode_cap(tps=8000.0, slots=16),
+                              _decode_cap(tps=5000.0, slots=8)])
+    rows = {r["metric"]: r for r in report["metrics"]}
+    assert rows["decode.tokens_per_sec"]["verdict"] \
+        == "skipped: no comparable prior capture"
+    assert report["ok"]

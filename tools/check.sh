@@ -30,7 +30,11 @@
 #      Zero unsuppressed findings required. The monitoring-plane modules
 #      (obs/tsdb.py sampler thread -> CC02 lifecycle + AT01 persistence,
 #      obs/rules.py edge state + obs/fleet.py poll thread -> CC01
-#      guarded_by) are covered with zero baseline entries.
+#      guarded_by) are covered with zero baseline entries, as are the
+#      continuous-batching decode modules (serve/kvcache.py free-list +
+#      tables and serve/decode.py scheduler state -> CC01 guarded_by;
+#      the bucketed decode step -> TS06 retrace-clean: one jit, per-
+#      bucket AOT sessions).
 #   3. coverage lints (full runs only — they span tests/ and docs/):
 #      --fault-coverage (every FaultPlan trip point armed by a test),
 #      --metric-drift (obs.registry emissions <-> docs/observability.md,
